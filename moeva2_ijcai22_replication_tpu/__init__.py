@@ -1,0 +1,24 @@
+"""TPU-native framework for adversarial attack and defense in constrained feature space.
+
+A ground-up JAX/XLA re-design of the capabilities of the IJCAI'22 MoEvA2
+replication package (`serval-uni-lu/moeva2-ijcai22-replication`): multi-objective
+evolutionary attacks, constrained gradient attacks (PGD/AutoPGD), MIP-based
+constraint-satisfying attacks, success-rate evaluation, and the matching defense
+pipelines — with the hot per-candidate evaluation loop (surrogate forward pass,
+constraint kernels, genetic operators, survival) batched on device as
+``(n_states, n_pop, n_genes)`` tensors inside a single jit, sharded over a
+``jax.sharding.Mesh``.
+
+Subpackages
+-----------
+- ``core``      feature schema, jittable genetic<->ML codec, constraint engine API
+- ``domains``   use-case plugins (LCLD credit scoring, CTU-13 botnet) + registry
+- ``models``    Flax surrogate classifiers, Keras/sklearn artifact importers, training
+- ``ops``       device kernels: non-dominated sort, niching, GA operators, ref dirs
+- ``attacks``   MoEvA2 (evolutionary), PGD/AutoPGD (gradient), MIP (exact), objectives
+- ``parallel``  mesh construction, sharding helpers, multi-host init
+- ``utils``     layered config system, metrics IO, timing/profiling
+- ``experiments`` RQ1-RQ4/SM1 runners and defense pipelines
+"""
+
+__version__ = "0.1.0"

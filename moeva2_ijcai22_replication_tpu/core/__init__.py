@@ -1,0 +1,15 @@
+from .schema import FeatureSchema, ConstraintBounds, OHE_PREFIX
+from .codec import Codec, make_codec
+from . import codec
+from .constraints import ConstraintSet, ConstraintViolationError
+
+__all__ = [
+    "FeatureSchema",
+    "ConstraintBounds",
+    "OHE_PREFIX",
+    "Codec",
+    "make_codec",
+    "codec",
+    "ConstraintSet",
+    "ConstraintViolationError",
+]

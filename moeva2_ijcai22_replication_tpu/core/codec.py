@@ -1,0 +1,283 @@
+"""Jittable genetic <-> ML <-> normalized feature transforms.
+
+The reference's ``FeatureEncoder`` (``/root/reference/src/attacks/moeva2/feature_encoder.py``)
+maintains three representations of a candidate:
+
+- **ML space** ``(D,)``: every feature, as the classifier consumes it;
+- **genetic space** ``(L,)``: only mutable features, with each one-hot group
+  collapsed to a single categorical gene — bound and one-hot validity hold by
+  construction;
+- **normalized space**: MinMax over per-feature bounds (sklearn semantics:
+  zero-range features get scale 1).
+
+This module re-designs those transforms TPU-first: all group structure is
+precomputed into *static padded index tables* so that every transform is a pure
+gather/scatter over the last axis — shape-static, differentiable where
+meaningful, and freely `vmap`-able over population and initial-state axes.
+Dynamic (per-sample) bounds are handled by passing per-state ``(S, D)`` bound
+tensors through the same broadcasting code paths.
+
+Genetic layout (matches the reference's, ``feature_encoder.py:97-110``): first
+all mutable non-OHE features in ML order, then one categorical gene per mutable
+OHE group.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .schema import FeatureSchema, OHE_PREFIX
+
+
+class Codec(NamedTuple):
+    """Static index tables driving the transforms (all shapes fixed at build).
+
+    Arrays live as device constants inside jitted computations; the codec is a
+    pytree so it can be closed over or passed as an argument.
+    """
+
+    non_ohe_ml_idx: jnp.ndarray  # (n1,) int32 — ML index of each non-OHE gene
+    group_ml_idx: jnp.ndarray  # (G, K) int32 — ML indices per OHE group, padded
+    group_pad_mask: jnp.ndarray  # (G, K) bool — True on real (non-pad) entries
+    group_sizes: jnp.ndarray  # (G,) int32
+    int_mask_gen: jnp.ndarray  # (L,) bool — genes needing integer rounding
+    mutable_mask: jnp.ndarray  # (D,) bool
+    n_features: int  # static
+    gen_length: int  # static
+
+    @property
+    def n_groups(self) -> int:
+        return self.group_ml_idx.shape[0]
+
+    @property
+    def n_non_ohe(self) -> int:
+        return self.non_ohe_ml_idx.shape[0]
+
+
+def _pad_group_tables(group_lists: list[list[int]]):
+    """Pad ragged index groups into (G, K) tables + validity mask.
+
+    Pad slots repeat the group's first member; pad scatters/gathers are always
+    masked out by the companion mask.
+    """
+    n_groups = len(group_lists)
+    max_k = max((len(g) for g in group_lists), default=1)
+    idx = np.zeros((n_groups, max_k), dtype=np.int32)
+    mask = np.zeros((n_groups, max_k), dtype=bool)
+    sizes = np.zeros((n_groups,), dtype=np.int32)
+    for gi, members in enumerate(group_lists):
+        idx[gi, : len(members)] = members
+        idx[gi, len(members):] = members[0] if members else 0
+        mask[gi, : len(members)] = True
+        sizes[gi] = len(members)
+    return idx, mask, sizes
+
+
+def make_codec(schema: FeatureSchema) -> Codec:
+    """Build the codec from a feature schema.
+
+    Mirrors the group discovery of ``FeatureEncoder._create_one_hot_encoders``
+    (``feature_encoder.py:58-86``) but materialised as padded index tables.
+    Only *mutable* features participate in the genetic space.
+    """
+    mutable = schema.mutable
+    types = [str(t) for t in schema.types]
+
+    # OHE groups among mutable features, in first-seen order.
+    groups: dict[str, list[int]] = {}
+    non_ohe_ml: list[int] = []
+    for i in range(schema.n_features):
+        if not mutable[i]:
+            continue
+        if types[i].startswith(OHE_PREFIX):
+            groups.setdefault(types[i], []).append(i)
+        else:
+            non_ohe_ml.append(i)
+
+    group_lists = list(groups.values())
+    n_groups = len(group_lists)
+    group_ml_idx, group_pad_mask, group_sizes = _pad_group_tables(group_lists)
+
+    int_mask = np.array(
+        [types[i] != "real" for i in non_ohe_ml] + [True] * n_groups, dtype=bool
+    )
+
+    return Codec(
+        non_ohe_ml_idx=jnp.asarray(np.array(non_ohe_ml, dtype=np.int32)),
+        group_ml_idx=jnp.asarray(group_ml_idx),
+        group_pad_mask=jnp.asarray(group_pad_mask),
+        group_sizes=jnp.asarray(group_sizes),
+        int_mask_gen=jnp.asarray(int_mask),
+        mutable_mask=jnp.asarray(np.asarray(mutable, dtype=bool)),
+        n_features=schema.n_features,
+        gen_length=len(non_ohe_ml) + n_groups,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Transforms. All operate on the trailing axis and broadcast over leading axes.
+# ---------------------------------------------------------------------------
+
+
+def scatter_groups(
+    x: jnp.ndarray,
+    group_idx: jnp.ndarray,
+    pad_mask: jnp.ndarray,
+    group_vals: jnp.ndarray,
+) -> jnp.ndarray:
+    """Scatter per-group value rows ``(..., G, K)`` into feature slots of
+    ``x`` ``(..., D)``, dropping padded entries via a sentinel column."""
+    d = x.shape[-1]
+    batch = jnp.broadcast_shapes(x.shape[:-1], group_vals.shape[:-2])
+    flat_idx = jnp.where(pad_mask, group_idx, d).reshape(-1)
+    flat_vals = jnp.broadcast_to(
+        group_vals, batch + group_vals.shape[-2:]
+    ).reshape(batch + (-1,))
+    padded = jnp.concatenate(
+        [
+            jnp.broadcast_to(x, batch + (d,)),
+            jnp.zeros(batch + (1,), x.dtype),
+        ],
+        axis=-1,
+    )
+    return padded.at[..., flat_idx].set(flat_vals)[..., :d]
+
+
+def genetic_to_ml(codec: Codec, x_gen: jnp.ndarray, x_init_ml: jnp.ndarray) -> jnp.ndarray:
+    """Decode genetic vectors into full ML vectors.
+
+    Immutable features are taken from the initial state; mutable non-OHE genes
+    scatter to their ML slots; categorical genes expand to one-hot groups.
+    Parity: ``FeatureEncoder.genetic_to_ml`` (``feature_encoder.py:112-130``).
+
+    ``x_gen``: (..., L); ``x_init_ml``: broadcastable to (..., D).
+    """
+    n1 = codec.n_non_ohe
+    batch = jnp.broadcast_shapes(x_gen.shape[:-1], x_init_ml.shape[:-1])
+    out = jnp.broadcast_to(x_init_ml, batch + (codec.n_features,))
+
+    # Non-OHE mutable genes.
+    out = out.at[..., codec.non_ohe_ml_idx].set(
+        jnp.broadcast_to(x_gen[..., :n1], batch + (n1,))
+    )
+
+    if codec.n_groups:
+        # Categorical genes -> one-hot rows.  (..., G, K)
+        cats = jnp.round(x_gen[..., n1:])
+        onehot = (cats[..., None] == jnp.arange(codec.group_ml_idx.shape[1])).astype(
+            out.dtype
+        )
+        out = scatter_groups(out, codec.group_ml_idx, codec.group_pad_mask, onehot)
+    return out
+
+
+def harden_onehot(
+    x: jnp.ndarray, group_idx: jnp.ndarray, pad_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Snap every one-hot group in ``x`` to a hard argmax one-hot."""
+    if group_idx.shape[0] == 0:
+        return x
+    vals = jnp.where(pad_mask, x[..., group_idx], -jnp.inf)
+    winner = jnp.argmax(vals, axis=-1)  # (..., G)
+    hard = (winner[..., None] == jnp.arange(group_idx.shape[1])).astype(x.dtype)
+    hard = jnp.where(pad_mask, hard, 0.0)
+    return scatter_groups(x, group_idx, pad_mask, hard)
+
+
+def ml_to_genetic(codec: Codec, x_ml: jnp.ndarray) -> jnp.ndarray:
+    """Encode ML vectors into the genetic representation.
+
+    Parity: ``FeatureEncoder.ml_to_genetic`` (``feature_encoder.py:126-127``);
+    one-hot groups collapse to argmax (the reference's OneHotEncoder inverse).
+    """
+    parts = [x_ml[..., codec.non_ohe_ml_idx]]
+    if codec.n_groups:
+        vals = x_ml[..., codec.group_ml_idx]  # (..., G, K)
+        vals = jnp.where(codec.group_pad_mask, vals, -jnp.inf)
+        parts.append(jnp.argmax(vals, axis=-1).astype(x_ml.dtype))
+    return jnp.concatenate(parts, axis=-1)
+
+
+def genetic_bounds(codec: Codec, xl_ml: jnp.ndarray, xu_ml: jnp.ndarray):
+    """Per-gene (xl, xu) from per-feature ML bounds (may carry leading axes).
+
+    Parity: ``FeatureEncoder.get_min_max_genetic`` (``feature_encoder.py:145-163``):
+    categorical genes range over [0, group_size - 1].
+    """
+    xl_ml = jnp.asarray(xl_ml, dtype=jnp.result_type(float))
+    xu_ml = jnp.asarray(xu_ml, dtype=xl_ml.dtype)
+    batch = xl_ml.shape[:-1]
+    cat_lo = jnp.broadcast_to(
+        jnp.zeros((codec.n_groups,), xl_ml.dtype), batch + (codec.n_groups,)
+    )
+    cat_hi = jnp.broadcast_to(
+        (codec.group_sizes - 1).astype(xu_ml.dtype), batch + (codec.n_groups,)
+    )
+    xl = jnp.concatenate([xl_ml[..., codec.non_ohe_ml_idx], cat_lo], axis=-1)
+    xu = jnp.concatenate([xu_ml[..., codec.non_ohe_ml_idx], cat_hi], axis=-1)
+    return xl, xu
+
+
+def minmax_normalize(x: jnp.ndarray, xl: jnp.ndarray, xu: jnp.ndarray) -> jnp.ndarray:
+    """sklearn-MinMaxScaler-semantics normalisation to [0, 1].
+
+    Zero-range features use scale 1 (``sklearn _handle_zeros_in_scale``), so a
+    degenerate feature maps to 0 — matching ``FeatureEncoder.normalise``.
+    """
+    rng = xu - xl
+    scale = jnp.where(rng == 0, 1.0, rng)
+    return (x - xl) / scale
+
+
+def minmax_denormalize(x: jnp.ndarray, xl: jnp.ndarray, xu: jnp.ndarray) -> jnp.ndarray:
+    rng = xu - xl
+    scale = jnp.where(rng == 0, 1.0, rng)
+    return x * scale + xl
+
+
+def round_int_genes(codec: Codec, x_gen: jnp.ndarray) -> jnp.ndarray:
+    """Round integer-typed genes (incl. categoricals) to the nearest integer."""
+    return jnp.where(codec.int_mask_gen, jnp.round(x_gen), x_gen)
+
+
+def clip_genetic(x_gen: jnp.ndarray, xl_gen: jnp.ndarray, xu_gen: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(x_gen, xl_gen, xu_gen)
+
+
+def ohe_distance(codec: Codec, x_ml: jnp.ndarray) -> jnp.ndarray:
+    """Sum over *mutable* groups of |1 - sum(group members)|.
+
+    NOTE: the reference's post-hoc oracle (``get_one_hot_encoding_constraints``,
+    ``moeva2/utils.py:43-54``) sums over ALL OHE groups in the type mask,
+    mutable or not — for that use :func:`all_ohe_groups_distance` with
+    :func:`full_ohe_tables`. This codec-level variant only sees the mutable
+    groups that exist in the genetic space.
+    """
+    if codec.n_groups == 0:
+        return jnp.zeros(x_ml.shape[:-1], x_ml.dtype)
+    vals = x_ml[..., codec.group_ml_idx]  # (..., G, K)
+    vals = jnp.where(codec.group_pad_mask, vals, 0.0)
+    return jnp.abs(1.0 - vals.sum(axis=-1)).sum(axis=-1)
+
+
+def all_ohe_groups_distance(groups_idx: jnp.ndarray, pad_mask: jnp.ndarray, x_ml: jnp.ndarray) -> jnp.ndarray:
+    """Same as :func:`ohe_distance` but over an explicit (G, K) index table —
+    used when immutable OHE groups must be included (full type-mask parity)."""
+    vals = jnp.where(pad_mask, x_ml[..., groups_idx], 0.0)
+    return jnp.abs(1.0 - vals.sum(axis=-1)).sum(axis=-1)
+
+
+def full_ohe_tables(schema: FeatureSchema):
+    """(G, K) padded index table + mask over ALL OHE groups (incl. immutable)."""
+    groups = [list(g) for g in schema.ohe_groups()]
+    if not groups:
+        return (
+            jnp.zeros((0, 1), dtype=jnp.int32),
+            jnp.zeros((0, 1), dtype=bool),
+        )
+    idx, mask, _ = _pad_group_tables(groups)
+    return jnp.asarray(idx), jnp.asarray(mask)

@@ -1,0 +1,127 @@
+"""Constraint-set API: pure jnp violation kernels + repair projections.
+
+The reference's abstract ``Constraints`` (``/root/reference/src/attacks/moeva2/constraints.py:8-77``)
+exposes violation evaluation (dual numpy/TF paths), feature metadata, and an
+in-graph repair (``fix_features_types``). Here the design is TPU-first: a single
+pure ``jax.numpy`` kernel ``(..., D) -> (..., K)`` serves every consumer —
+the evolutionary attack's objective (vmapped over states x population), the
+gradient attack's loss (differentiated), and post-hoc evaluation — with two
+thresholding flavours:
+
+- ``evaluate``: hard oracle semantics — violations ``<= tol`` snap to exactly 0
+  (parity with the numpy path, e.g. ``lcld_constraints.py:221``);
+- ``evaluate_smooth``: ``max(g - tol, 0)`` — the differentiable flavour used in
+  gradient losses (parity with the TF path, ``lcld_constraints.py:155``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from .schema import ConstraintBounds, FeatureSchema
+
+DEFAULT_TOL = 1e-3
+
+
+class ConstraintViolationError(ValueError):
+    pass
+
+
+class ConstraintSet:
+    """A use case's relational feature constraints.
+
+    Subclasses implement ``_raw`` returning *unthresholded* violation
+    magnitudes ``(..., K)`` from ML-space inputs ``(..., D)`` as pure jnp.
+    """
+
+    #: number of constraints K
+    n_constraints: int = 0
+    tol: float = DEFAULT_TOL
+
+    def __init__(self, schema: FeatureSchema, bounds: ConstraintBounds | None = None):
+        self.schema = schema
+        self.constraint_bounds = bounds
+
+    # -- to implement ------------------------------------------------------
+    def _raw(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def repair(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Project candidates toward constraint satisfaction (in-graph).
+
+        Parity: ``Constraints.fix_features_types``. Default: identity (the
+        botnet reference behaviour, ``botnet_constraints.py:14-15``).
+        """
+        return x
+
+    # -- provided ----------------------------------------------------------
+    def evaluate(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Hard-thresholded violations: value if > tol else exactly 0."""
+        g = self._raw(x)
+        return jnp.where(g > self.tol, g, 0.0)
+
+    def evaluate_smooth(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Differentiable violations: clip(g - tol, 0, inf)."""
+        return jnp.clip(self._raw(x) - self.tol, 0.0, jnp.inf)
+
+    def normalise(self, g: jnp.ndarray) -> jnp.ndarray:
+        if self.constraint_bounds is None:
+            return g
+        cmin = jnp.asarray(self.constraint_bounds.cmin)
+        rng = jnp.asarray(self.constraint_bounds.cmax) - cmin
+        rng = jnp.where(rng == 0, 1.0, rng)
+        return (g - cmin) / rng
+
+    def check_constraints_error(self, x: np.ndarray) -> None:
+        """Raise if any sample violates any constraint.
+
+        Parity: ``Constraints.check_constraints_error`` (``constraints.py:73-77``).
+        """
+        g = np.asarray(self.evaluate(jnp.asarray(x)))
+        n_bad = int((g > 0).sum())
+        if n_bad > 0:
+            raise ConstraintViolationError(
+                f"{n_bad} constraint violations across {int((g.sum(-1) > 0).sum())} "
+                f"samples (max violation {float(np.nanmax(g)):.6g})."
+            )
+
+    # -- metadata (delegates to the schema) --------------------------------
+    def get_mutable_mask(self) -> np.ndarray:
+        return np.asarray(self.schema.mutable)
+
+    def get_feature_type(self) -> np.ndarray:
+        return np.asarray(self.schema.types)
+
+    def get_feature_min_max(self, dynamic_input=None):
+        return self.schema.bounds(dynamic_input)
+
+    def get_nb_constraints(self) -> int:
+        return self.n_constraints
+
+
+class FunctionalConstraintSet(ConstraintSet):
+    """Wrap a plain function ``(x) -> (..., K)`` as a ConstraintSet."""
+
+    def __init__(
+        self,
+        schema: FeatureSchema,
+        fn: Callable[[jnp.ndarray], jnp.ndarray],
+        n_constraints: int,
+        bounds: ConstraintBounds | None = None,
+        repair_fn: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+    ):
+        super().__init__(schema, bounds)
+        self._fn = fn
+        self.n_constraints = n_constraints
+        self._repair_fn = repair_fn
+
+    def _raw(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self._fn(x)
+
+    def repair(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self._repair_fn is None:
+            return x
+        return self._repair_fn(x)

@@ -1,0 +1,34 @@
+"""Use-case domain plugins and their registry.
+
+Parity: the reference's project-name -> constraint-class lookup
+(``/root/reference/src/experiments/united/utils.py:12-30``).
+"""
+
+from .lcld import LcldConstraints, LcldAugmentedConstraints
+from .botnet import BotnetConstraints, BotnetAugmentedConstraints
+
+CONSTRAINTS_REGISTRY = {
+    "lcld": LcldConstraints,
+    "botnet": BotnetConstraints,
+    "lcld_augmented": LcldAugmentedConstraints,
+    "botnet_augmented": BotnetAugmentedConstraints,
+}
+
+
+def get_constraints_class(project_name: str):
+    try:
+        return CONSTRAINTS_REGISTRY[project_name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown project {project_name!r}; known: {sorted(CONSTRAINTS_REGISTRY)}"
+        ) from None
+
+
+__all__ = [
+    "LcldConstraints",
+    "LcldAugmentedConstraints",
+    "BotnetConstraints",
+    "BotnetAugmentedConstraints",
+    "CONSTRAINTS_REGISTRY",
+    "get_constraints_class",
+]

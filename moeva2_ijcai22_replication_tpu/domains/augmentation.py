@@ -1,0 +1,85 @@
+"""XOR feature augmentation — the "engineering defense".
+
+For the top-k important features, every pair (i, j) contributes a derived
+binary feature ``XOR(x_i >= mean_i, x_j >= mean_j)``. Appending these to the
+dataset makes the corresponding consistency constraints learnable.
+
+Parity: ``augment_data`` (``/root/reference/src/experiments/botnet/features.py:6-21``)
+and the consistency terms ``constraints_augmented_np/tf``
+(``/root/reference/src/examples/utils.py:7-56``).
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from math import comb
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pair_table(important_features: np.ndarray):
+    """Static (P, 2) index and (P, 2) threshold tables over all pairs.
+
+    ``important_features``: (k, 2) rows of [feature_index, threshold_mean].
+    """
+    k = important_features.shape[0]
+    pairs = list(combinations(range(k), 2))
+    idx = np.array(
+        [[int(important_features[i, 0]), int(important_features[j, 0])] for i, j in pairs],
+        dtype=np.int32,
+    )
+    thr = np.array(
+        [[important_features[i, 1], important_features[j, 1]] for i, j in pairs]
+    )
+    return idx, thr
+
+
+def n_pairs(important_features: np.ndarray) -> int:
+    return comb(important_features.shape[0], 2)
+
+
+def xor_features(x: jnp.ndarray, important_features: np.ndarray) -> jnp.ndarray:
+    """Compute the (…, P) XOR pair features from base features."""
+    idx, thr = pair_table(important_features)
+    above = x[..., jnp.asarray(idx)] >= jnp.asarray(thr)  # (..., P, 2)
+    return jnp.logical_xor(above[..., 0], above[..., 1]).astype(x.dtype)
+
+
+def augment(x: jnp.ndarray, important_features: np.ndarray) -> jnp.ndarray:
+    """Append XOR pair features along the last axis (any leading shape)."""
+    return jnp.concatenate([x, xor_features(x, important_features)], axis=-1)
+
+
+def consistency_terms(x: jnp.ndarray, important_features: np.ndarray) -> jnp.ndarray:
+    """|x_aug - XOR(...)| per pair: the augmented-constraint violation terms.
+
+    The augmented features are assumed to occupy the LAST P columns of ``x``
+    (reference layout). Returns (…, P). For repeated evaluation (constraint
+    kernels), prefer a prebuilt :class:`PairTables`.
+    """
+    return PairTables.build(important_features).consistency_terms(x)
+
+
+class PairTables(NamedTuple):
+    """Precomputed pair index/threshold tables for hot-loop use."""
+
+    idx: jnp.ndarray  # (P, 2) int32
+    thr: jnp.ndarray  # (P, 2)
+
+    @classmethod
+    def build(cls, important_features: np.ndarray) -> "PairTables":
+        idx, thr = pair_table(important_features)
+        return cls(idx=jnp.asarray(idx), thr=jnp.asarray(thr))
+
+    @property
+    def n_pairs(self) -> int:
+        return self.idx.shape[0]
+
+    def xor_features(self, x: jnp.ndarray) -> jnp.ndarray:
+        above = x[..., self.idx] >= self.thr  # (..., P, 2)
+        return jnp.logical_xor(above[..., 0], above[..., 1]).astype(x.dtype)
+
+    def consistency_terms(self, x: jnp.ndarray) -> jnp.ndarray:
+        return jnp.abs(x[..., -self.n_pairs :] - self.xor_features(x))

@@ -1,0 +1,126 @@
+"""LCLD (LendingClub loan data) domain: 47 features, 10 relational constraints.
+
+One pure jnp kernel serves evaluation and differentiation; the hard/smooth
+thresholding split lives in :class:`~..core.constraints.ConstraintSet`.
+
+Reference parity (formula-for-formula, not line-for-line):
+``/root/reference/src/examples/lcld/lcld_constraints.py`` — numpy oracle at
+:168-223, TF twin at :75-157, repair at :40-73; augmented variant at
+``lcld_augmented_constraints.py`` (10 base + C(5,2)=10 XOR-consistency terms).
+
+Feature indices used (see ``data/lcld/features.csv``):
+0 loan_amnt, 1 term, 2 int_rate, 3 installment, 6 annual_inc, 7 issue_d,
+9 earliest_cr_line, 10 open_acc, 11 pub_rec, 14 total_acc,
+16 pub_rec_bankruptcies, 20..25 derived ratio features.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.codec import full_ohe_tables, harden_onehot
+from ..core.constraints import ConstraintSet
+from ..core.schema import ConstraintBounds, FeatureSchema
+from . import augmentation
+
+N_BASE_FEATURES = 47
+
+
+def _months(date_feature: jnp.ndarray) -> jnp.ndarray:
+    """YYYYMM integer-coded date -> month count (floor(f/100)*12 + f mod 100)."""
+    return jnp.floor(date_feature / 100.0) * 12.0 + jnp.mod(date_feature, 100.0)
+
+
+def _installment(loan_amnt, term, int_rate):
+    """Amortised monthly payment: L*r*(1+r)^t / ((1+r)^t - 1), r = rate/1200."""
+    r = int_rate / 1200.0
+    growth = jnp.power(1.0 + r, term)
+    return loan_amnt * r * growth / (growth - 1.0)
+
+
+def lcld_constraint_terms(x: jnp.ndarray) -> jnp.ndarray:
+    """Unthresholded violation magnitudes, shape (..., 10)."""
+    g1 = jnp.abs(x[..., 3] - _installment(x[..., 0], x[..., 1], x[..., 2])) - 0.099999
+    # open_acc <= total_acc ; pub_rec_bankruptcies <= pub_rec
+    g2 = x[..., 10] - x[..., 14]
+    g3 = x[..., 16] - x[..., 11]
+    # term must be one of {36, 60}
+    g4 = jnp.abs((36.0 - x[..., 1]) * (60.0 - x[..., 1]))
+    # derived-ratio equalities
+    g5 = jnp.abs(x[..., 20] - x[..., 0] / x[..., 6])
+    g6 = jnp.abs(x[..., 21] - x[..., 10] / x[..., 14])
+    g7 = jnp.abs(x[..., 22] - (_months(x[..., 7]) - _months(x[..., 9])))
+    g8 = jnp.abs(x[..., 23] - x[..., 11] / x[..., 22])
+    g9 = jnp.abs(x[..., 24] - x[..., 16] / x[..., 22])
+    # pub_rec_bankruptcies / pub_rec, with 0-denominator (and any non-finite
+    # result) mapped to the sentinel -1 — the reference's masked-array dance.
+    denom_ok = x[..., 11] != 0
+    ratio = jnp.where(denom_ok, x[..., 16] / jnp.where(denom_ok, x[..., 11], 1.0), -1.0)
+    ratio = jnp.where(jnp.isfinite(ratio), ratio, -1.0)
+    g10 = jnp.abs(x[..., 25] - ratio)
+    return jnp.stack([g1, g2, g3, g4, g5, g6, g7, g8, g9, g10], axis=-1)
+
+
+class LcldConstraints(ConstraintSet):
+    n_constraints = 10
+
+    def __init__(
+        self,
+        features_path: str,
+        constraints_path: str,
+        important_features_path: str | None = None,
+    ):
+        schema = FeatureSchema.from_csv(features_path)
+        bounds = ConstraintBounds.from_csv(constraints_path)
+        super().__init__(schema, bounds)
+        if important_features_path is None:
+            important_features_path = os.path.join(
+                os.path.dirname(features_path), "important_features.npy"
+            )
+        self.important_features = (
+            np.load(important_features_path)
+            if os.path.exists(important_features_path)
+            else None
+        )
+        self._ohe_idx, self._ohe_mask = full_ohe_tables(schema)
+
+    def _raw(self, x: jnp.ndarray) -> jnp.ndarray:
+        return lcld_constraint_terms(x)
+
+    def repair(self, x: jnp.ndarray) -> jnp.ndarray:
+        """In-graph constructive repair (parity: ``fix_features_types``):
+        snap term to {36, 60}, recompute installment by formula, harden every
+        one-hot group to its argmax, and re-derive augmented XOR features when
+        the input carries them."""
+        term = jnp.where(x[..., 1] < (60.0 + 36.0) / 2.0, 36.0, 60.0)
+        x = x.at[..., 1].set(term)
+        x = x.at[..., 3].set(_installment(x[..., 0], term, x[..., 2]))
+
+        x = harden_onehot(x, self._ohe_idx, self._ohe_mask)
+
+        if x.shape[-1] > N_BASE_FEATURES and self.important_features is not None:
+            base = x[..., : -augmentation.n_pairs(self.important_features)]
+            x = augmentation.augment(base, self.important_features)
+        return x
+
+
+class LcldAugmentedConstraints(LcldConstraints):
+    """LCLD + XOR-consistency constraints on the augmented features (10+10)."""
+
+    n_constraints = 20
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.important_features is None:
+            raise FileNotFoundError(
+                "LcldAugmentedConstraints requires important_features.npy "
+                "(pass important_features_path or place it next to features.csv)"
+            )
+        self._pairs = augmentation.PairTables.build(self.important_features)
+
+    def _raw(self, x: jnp.ndarray) -> jnp.ndarray:
+        base = lcld_constraint_terms(x)
+        return jnp.concatenate([base, self._pairs.consistency_terms(x)], axis=-1)

@@ -1,0 +1,85 @@
+"""Synthetic constraint-satisfying sample generators.
+
+The reference ships real candidate sets for botnet (387 x 756) but none for
+LCLD (its LCLD candidates are produced by a defense pipeline over the raw
+LendingClub dataset, which is not redistributed). This module constructs LCLD
+samples that satisfy all 10 relational constraints *by construction* — usable
+as attack seeds, test fixtures, and benchmark inputs.
+
+Schema: ``data/lcld/features.csv`` (see ``domains/lcld.py`` for the index map).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.schema import FeatureSchema
+
+
+def _random_date(rng, lo_yyyymm: int, hi_yyyymm: int, size) -> np.ndarray:
+    """Uniform YYYYMM dates with valid months."""
+    lo_m = (lo_yyyymm // 100) * 12 + lo_yyyymm % 100
+    hi_m = (hi_yyyymm // 100) * 12 + hi_yyyymm % 100
+    months = rng.integers(lo_m, hi_m + 1, size=size)
+    # months here are absolute counts with month in 1..12 encoded as offset
+    year, month = (months - 1) // 12, (months - 1) % 12 + 1
+    return (year * 100 + month).astype(np.float64)
+
+
+def _months(f: np.ndarray) -> np.ndarray:
+    return np.floor(f / 100) * 12 + f % 100
+
+
+def synth_lcld(
+    n: int, schema: FeatureSchema, seed: int = 0, label_rate: float = 0.5
+) -> np.ndarray:
+    """Generate ``n`` LCLD samples satisfying all 10 constraints exactly."""
+    rng = np.random.default_rng(seed)
+    d = schema.n_features
+    x = np.zeros((n, d))
+
+    x[:, 0] = rng.uniform(1000, 40000, n)  # loan_amnt
+    x[:, 1] = rng.choice([36.0, 60.0], n)  # term
+    x[:, 2] = rng.uniform(5.31, 30.99, n)  # int_rate
+    r = x[:, 2] / 1200.0
+    growth = (1.0 + r) ** x[:, 1]
+    x[:, 3] = x[:, 0] * r * growth / (growth - 1.0)  # installment
+    x[:, 4] = rng.integers(1, 8, n)  # grade
+    x[:, 5] = rng.integers(0, 11, n)  # emp_length
+    x[:, 6] = rng.uniform(20000, 300000, n)  # annual_inc
+    x[:, 7] = _random_date(rng, 201203, 201812, n)  # issue_d
+    x[:, 8] = rng.uniform(0, 40, n)  # dti
+    # earliest_cr_line at least 36 months before issue_d (bound of feature 22)
+    issue_m = _months(x[:, 7])
+    offset = rng.integers(36, 300, n).astype(np.float64)
+    ecl_m = issue_m - offset
+    year, month = (ecl_m - 1) // 12, (ecl_m - 1) % 12 + 1
+    x[:, 9] = year * 100 + month  # earliest_cr_line
+    x[:, 14] = np.round(rng.uniform(2, 80, n))  # total_acc
+    x[:, 10] = np.round(rng.uniform(1, x[:, 14]))  # open_acc <= total_acc
+    x[:, 11] = np.round(rng.uniform(0, 5, n) * (rng.random(n) < 0.3))  # pub_rec
+    x[:, 12] = rng.uniform(0, 100000, n)  # revol_bal
+    x[:, 13] = rng.uniform(0, 150, n)  # revol_util
+    x[:, 15] = np.round(rng.uniform(0, 10, n))  # mort_acc
+    x[:, 16] = np.round(rng.uniform(0, x[:, 11]))  # pub_rec_bankruptcies <= pub_rec
+    x[:, 17] = rng.uniform(662, 847.5, n)  # fico_score
+    x[:, 18] = rng.integers(0, 2, n)  # initial_list_status_w
+    x[:, 19] = rng.integers(0, 2, n)  # application_type_Joint App
+
+    diff = issue_m - _months(x[:, 9])
+    x[:, 20] = x[:, 0] / x[:, 6]
+    x[:, 21] = x[:, 10] / x[:, 14]
+    x[:, 22] = diff
+    x[:, 23] = x[:, 11] / diff
+    x[:, 24] = x[:, 16] / diff
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.where(x[:, 11] == 0, -1.0, x[:, 16] / np.where(x[:, 11] == 0, 1, x[:, 11]))
+    x[:, 25] = ratio
+
+    # One-hot groups: pick one member per group uniformly.
+    for group in schema.ohe_groups():
+        choice = rng.integers(0, len(group), n)
+        x[np.arange(n)[:, None], np.asarray(group)[None, :]] = 0.0
+        x[np.arange(n), np.asarray(group)[choice]] = 1.0
+
+    return x

@@ -1,0 +1,17 @@
+from .mlp import MLP, lcld_mlp, botnet_mlp, forward_logits, predict_proba
+from .scalers import MinMaxParams, from_sklearn_minmax, load_joblib_scaler
+from .io import load_classifier, save_params, load_params
+
+__all__ = [
+    "MLP",
+    "lcld_mlp",
+    "botnet_mlp",
+    "forward_logits",
+    "predict_proba",
+    "MinMaxParams",
+    "from_sklearn_minmax",
+    "load_joblib_scaler",
+    "load_classifier",
+    "save_params",
+    "load_params",
+]

@@ -1,0 +1,122 @@
+"""Model artifact IO: Keras SavedModel import, Flax param (de)serialisation.
+
+The reference persists surrogates as Keras SavedModel directories
+(``models/<project>/*.model``, loaded by ``src/utils/in_out.py:111-127``).
+To attack those exact committed models from JAX, we import their Dense
+kernels/biases into Flax params; topology is inferred from kernel shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .mlp import MLP, forward_logits, predict_proba
+
+
+@dataclass
+class Surrogate:
+    """A classifier = Flax module + params; behaves like the reference's
+    duck-typed ``Classifier`` wrapper (``moeva2/classifier.py:4-41``)."""
+
+    model: MLP
+    params: Any
+
+    def logits(self, x: jnp.ndarray) -> jnp.ndarray:
+        return forward_logits(self.model, self.params, x)
+
+    def predict_proba(self, x: jnp.ndarray) -> jnp.ndarray:
+        # Sigmoid-head (1-column) outputs expand to 2 columns, mirroring the
+        # reference's duck-typed wrapper (classifier.py:27-28).
+        probs = predict_proba(self.model, self.params, x)
+        if probs.shape[-1] == 1:
+            probs = jnp.concatenate([1.0 - probs, probs], axis=-1)
+        return probs
+
+
+def _dense_stack_from_savedmodel(path: str):
+    """Extract ordered (kernel, bias) pairs from a Keras SavedModel dir."""
+    import tensorflow as tf
+
+    loaded = tf.saved_model.load(path)
+    kernels, biases = [], []
+    for v in loaded.variables:
+        arr = v.numpy()
+        if v.name.endswith("kernel:0"):
+            kernels.append(arr)
+        elif v.name.endswith("bias:0"):
+            biases.append(arr)
+    if not kernels or len(kernels) != len(biases):
+        raise ValueError(f"Could not extract dense stack from {path}")
+    # Order by connectivity: input dim of layer k equals output dim of k-1.
+    ordered = [kernels.pop(0)]
+    ordered_b = [biases.pop(0)]
+    while kernels:
+        out_dim = ordered[-1].shape[1]
+        for i, k in enumerate(kernels):
+            if k.shape[0] == out_dim:
+                ordered.append(kernels.pop(i))
+                ordered_b.append(biases.pop(i))
+                break
+        else:
+            raise ValueError("Dense layers do not chain; cannot infer topology")
+    return ordered, ordered_b
+
+
+def flax_params_from_dense_stack(kernels, biases):
+    return {
+        "params": {
+            f"Dense_{i}": {"kernel": jnp.asarray(k), "bias": jnp.asarray(b)}
+            for i, (k, b) in enumerate(zip(kernels, biases))
+        }
+    }
+
+
+def load_keras_model(path: str) -> Surrogate:
+    kernels, biases = _dense_stack_from_savedmodel(path)
+    hidden = tuple(k.shape[1] for k in kernels[:-1])
+    model = MLP(hidden=hidden, n_classes=kernels[-1].shape[1])
+    return Surrogate(model=model, params=flax_params_from_dense_stack(kernels, biases))
+
+
+def load_classifier(path: str) -> Surrogate:
+    """Dispatch on artifact type (parity: ``in_out.load_model``)."""
+    if path.endswith(".model") or os.path.isdir(path):
+        return load_keras_model(path)
+    if path.endswith((".msgpack", ".flax")):
+        return load_params(path)
+    raise ValueError(f"Unknown model artifact: {path}")
+
+
+def save_params(surrogate: Surrogate, path: str) -> None:
+    from flax import serialization
+
+    meta = np.array(
+        list(surrogate.model.hidden) + [surrogate.model.n_classes], dtype=np.int64
+    )
+    with open(path, "wb") as f:
+        np.save(f, meta, allow_pickle=False)
+        f.write(serialization.to_bytes(surrogate.params))
+
+
+def load_params(path: str) -> Surrogate:
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        meta = np.load(f)
+        hidden, n_classes = tuple(int(v) for v in meta[:-1]), int(meta[-1])
+        model = MLP(hidden=hidden, n_classes=n_classes)
+        raw = f.read()
+    template = _empty_params_like(model)
+    params = serialization.from_bytes(template, raw)
+    return Surrogate(model=model, params=params)
+
+
+def _empty_params_like(model: MLP):
+    # from_bytes needs a matching tree structure; leaf shapes come from bytes.
+    names = [f"Dense_{i}" for i in range(len(model.hidden) + 1)]
+    return {"params": {n: {"kernel": jnp.zeros(()), "bias": jnp.zeros(())} for n in names}}

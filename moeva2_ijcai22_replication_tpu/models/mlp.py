@@ -1,0 +1,53 @@
+"""Flax surrogate classifiers.
+
+The reference's surrogates are tiny Keras Sequential MLPs
+(``/root/reference/src/experiments/lcld/model.py:9-20``: 64-32-16-2 relu+softmax;
+``botnet/model.py:9-24``: 64-64-32-2). Here they are Flax modules whose forward
+pass is a plain function of (params, x) — freely jit/vmap/grad-able and
+shardable. Probabilities come from a softmax head; use ``forward_logits`` in
+losses for numerical stability.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Dense relu stack with a linear (logit) head."""
+
+    hidden: Sequence[int]
+    n_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(self.n_classes)(x)
+
+
+def lcld_mlp() -> MLP:
+    return MLP(hidden=(64, 32, 16))
+
+
+def botnet_mlp() -> MLP:
+    return MLP(hidden=(64, 64, 32))
+
+
+def forward_logits(model: MLP, params, x: jnp.ndarray) -> jnp.ndarray:
+    return model.apply(params, x)
+
+
+def predict_proba(model: MLP, params, x: jnp.ndarray) -> jnp.ndarray:
+    logits = forward_logits(model, params, x)
+    if logits.shape[-1] == 1:  # sigmoid head
+        return jax.nn.sigmoid(logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def init_params(model: MLP, n_features: int, seed: int = 0):
+    return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, n_features)))

@@ -1,0 +1,48 @@
+"""MinMax feature scaling as jittable parameter structs.
+
+The reference scales classifier inputs with fitted sklearn ``MinMaxScaler``
+objects persisted as ``scaler.joblib`` (``/root/reference/src/experiments/
+lcld/01_train_robust.py:50-66``). We represent a fitted scaler as a small
+pytree so transforms run in-graph on device, and provide importers from
+sklearn objects / joblib files.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class MinMaxParams(NamedTuple):
+    scale: jnp.ndarray  # multiply
+    min_: jnp.ndarray  # then add  (sklearn's X * scale_ + min_)
+
+    def transform(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x * self.scale + self.min_
+
+    def inverse(self, x: jnp.ndarray) -> jnp.ndarray:
+        return (x - self.min_) / self.scale
+
+
+def fit_minmax(x_min: np.ndarray, x_max: np.ndarray) -> MinMaxParams:
+    """Fit to explicit per-feature bounds (sklearn zero-range semantics)."""
+    rng = np.asarray(x_max, dtype=float) - np.asarray(x_min, dtype=float)
+    scale = 1.0 / np.where(rng == 0, 1.0, rng)
+    return MinMaxParams(
+        scale=jnp.asarray(scale), min_=jnp.asarray(-np.asarray(x_min) * scale)
+    )
+
+
+def from_sklearn_minmax(scaler) -> MinMaxParams:
+    return MinMaxParams(
+        scale=jnp.asarray(np.asarray(scaler.scale_)),
+        min_=jnp.asarray(np.asarray(scaler.min_)),
+    )
+
+
+def load_joblib_scaler(path: str) -> MinMaxParams:
+    import joblib
+
+    return from_sklearn_minmax(joblib.load(path))

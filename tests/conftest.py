@@ -1,0 +1,57 @@
+"""Test harness: force a virtual 8-device CPU mesh and fp64 before JAX loads.
+
+Multi-device sharding logic is tested hardware-free via
+``--xla_force_host_platform_device_count`` (the TPU analog of a fake backend);
+fp64 is enabled so constraint kernels can be checked at oracle precision.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU platform via env; override
+# both config knobs explicitly so tests run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DATA = "/root/reference/data"
+
+
+@pytest.fixture(scope="session")
+def ref_data_dir():
+    if not os.path.isdir(REFERENCE_DATA):
+        pytest.skip("reference data not available")
+    return REFERENCE_DATA
+
+
+@pytest.fixture(scope="session")
+def lcld_paths(ref_data_dir):
+    return {
+        "features": os.path.join(ref_data_dir, "lcld", "features.csv"),
+        "constraints": os.path.join(ref_data_dir, "lcld", "constraints.csv"),
+    }
+
+
+@pytest.fixture(scope="session")
+def botnet_paths(ref_data_dir):
+    return {
+        "features": os.path.join(ref_data_dir, "botnet", "features.csv"),
+        "constraints": os.path.join(ref_data_dir, "botnet", "constraints.csv"),
+        "candidates": os.path.join(ref_data_dir, "botnet", "x_candidates_common.npy"),
+    }
+
+
+@pytest.fixture(scope="session")
+def botnet_candidates(botnet_paths):
+    return np.load(botnet_paths["candidates"])

@@ -1,0 +1,122 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.core.constraints import ConstraintViolationError
+from moeva2_ijcai22_replication_tpu.domains import (
+    BotnetConstraints,
+    LcldConstraints,
+    get_constraints_class,
+)
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+
+
+@pytest.fixture(scope="module")
+def lcld(lcld_paths):
+    return LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+
+
+@pytest.fixture(scope="module")
+def botnet(botnet_paths):
+    return BotnetConstraints(botnet_paths["features"], botnet_paths["constraints"])
+
+
+def test_registry():
+    assert get_constraints_class("lcld") is LcldConstraints
+    with pytest.raises(ValueError):
+        get_constraints_class("nope")
+
+
+def test_lcld_synth_satisfies(lcld):
+    x = synth_lcld(256, lcld.schema, seed=0)
+    g = np.asarray(lcld.evaluate(jnp.asarray(x)))
+    assert g.shape == (256, 10)
+    assert np.all(g == 0.0), f"max violation {g.max()} at {np.unravel_index(g.argmax(), g.shape)}"
+    lcld.check_constraints_error(x)  # should not raise
+
+
+def test_lcld_violations_detected(lcld):
+    x = synth_lcld(16, lcld.schema, seed=5)
+    # Break the installment formula (constraint 0)
+    x1 = x.copy()
+    x1[:, 3] += 5.0
+    g = np.asarray(lcld.evaluate(jnp.asarray(x1)))
+    assert np.all(g[:, 0] > 0)
+    # Break open_acc <= total_acc (constraint 1)
+    x2 = x.copy()
+    x2[:, 10] = x2[:, 14] + 3
+    g = np.asarray(lcld.evaluate(jnp.asarray(x2)))
+    assert np.all(g[:, 1] > 0)
+    # Term not in {36, 60} (constraint 3)
+    x3 = x.copy()
+    x3[:, 1] = 48
+    g = np.asarray(lcld.evaluate(jnp.asarray(x3)))
+    assert np.all(g[:, 3] > 0)
+    with pytest.raises(ConstraintViolationError):
+        lcld.check_constraints_error(x3)
+
+
+def test_lcld_divzero_sentinel(lcld):
+    x = synth_lcld(8, lcld.schema, seed=6)
+    x[:, 11] = 0.0  # pub_rec = 0
+    x[:, 16] = 0.0
+    x[:, 23] = x[:, 11] / x[:, 22]
+    x[:, 24] = x[:, 16] / x[:, 22]
+    x[:, 25] = -1.0  # sentinel expected by the oracle
+    g = np.asarray(lcld.evaluate(jnp.asarray(x)))
+    assert np.all(g[:, 9] == 0.0)
+
+
+def test_lcld_repair(lcld):
+    x = synth_lcld(32, lcld.schema, seed=7)
+    x_broken = x.copy()
+    x_broken[:, 1] = 42.0  # invalid term
+    x_broken[:, 3] += 30.0  # broken installment
+    repaired = np.asarray(lcld.repair(jnp.asarray(x_broken)))
+    g = np.asarray(lcld.evaluate(jnp.asarray(repaired)))
+    assert np.all(g[:, 0] == 0.0)  # installment formula restored
+    assert np.all(g[:, 3] == 0.0)  # term snapped to {36,60}
+    assert set(np.unique(repaired[:, 1])) <= {36.0, 60.0}
+
+
+def test_lcld_smooth_vs_hard(lcld):
+    x = synth_lcld(16, lcld.schema, seed=8)
+    x[:, 3] += 1.0
+    hard = np.asarray(lcld.evaluate(jnp.asarray(x)))
+    smooth = np.asarray(lcld.evaluate_smooth(jnp.asarray(x)))
+    # hard keeps raw magnitude; smooth shifts by tol — both flag the same set
+    assert np.array_equal(hard > 0, smooth > 0)
+    np.testing.assert_allclose(hard[hard > 0] - smooth[smooth > 0], lcld.tol, rtol=1e-6)
+
+
+def test_lcld_gradients(lcld):
+    import jax
+
+    x = jnp.asarray(synth_lcld(4, lcld.schema, seed=9))
+    loss = lambda z: lcld.evaluate_smooth(z).sum()
+    grads = jax.grad(loss)(x + 0.01)
+    assert np.all(np.isfinite(np.asarray(grads)))
+
+
+def test_botnet_real_candidates_satisfy(botnet, botnet_candidates):
+    # The reference runs check_constraints_error on this exact set before
+    # attacking (04_moeva.py:64) — our kernel must agree it is clean.
+    g = np.asarray(botnet.evaluate(jnp.asarray(botnet_candidates)))
+    assert g.shape == (387, 360)
+    assert np.all(g == 0.0), f"max violation {g.max()}"
+
+
+def test_botnet_violations_detected(botnet, botnet_candidates):
+    x = np.array(botnet_candidates[:8])
+    # Violate a min<=max ordering: set a min above its max counterpart.
+    lo, up = botnet._orderings[2]
+    lo0, up0 = int(np.asarray(lo)[0]), int(np.asarray(up)[0])
+    x[:, lo0] = x[:, up0] + 10.0
+    g = np.asarray(botnet.evaluate(jnp.asarray(x)))
+    assert np.all(g.sum(axis=1) > 0)
+
+
+def test_botnet_batched_shapes(botnet, botnet_candidates):
+    x = jnp.asarray(botnet_candidates[:6]).reshape(2, 3, -1)
+    g = np.asarray(botnet.evaluate(x))
+    assert g.shape == (2, 3, 360)

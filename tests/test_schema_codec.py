@@ -1,0 +1,116 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.core import FeatureSchema, make_codec
+from moeva2_ijcai22_replication_tpu.core import codec as C
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+
+
+@pytest.fixture(scope="module")
+def lcld_schema(lcld_paths):
+    return FeatureSchema.from_csv(lcld_paths["features"])
+
+
+def test_lcld_schema_shape(lcld_schema):
+    assert lcld_schema.n_features == 47
+    assert lcld_schema.mutable.sum() == 28
+    assert len(lcld_schema.ohe_groups()) == 3
+    assert not lcld_schema.has_dynamic_bounds
+
+
+def test_botnet_schema_dynamic(botnet_paths):
+    schema = FeatureSchema.from_csv(botnet_paths["features"])
+    assert schema.n_features == 756
+    assert schema.mutable.sum() == 432
+    assert schema.has_dynamic_bounds
+    # dynamic bounds resolve from the input sample
+    x = np.arange(756, dtype=float)
+    xl, xu = schema.bounds(x)
+    assert np.all(xl[schema.min_dynamic] == x[schema.min_dynamic])
+    assert np.all(xu[schema.max_dynamic] == x[schema.max_dynamic])
+    # batched resolution
+    xb = np.stack([x, x + 1.0])
+    xlb, xub = schema.bounds(xb)
+    assert xlb.shape == (2, 756)
+    assert np.all(xub[1, schema.max_dynamic] == xb[1, schema.max_dynamic])
+
+
+def test_lcld_codec_structure(lcld_schema):
+    codec = make_codec(lcld_schema)
+    # 28 mutable features, 1 mutable OHE group (purpose, 14 members):
+    # 14 mutable non-OHE? -> genetic length = n_non_ohe + n_groups
+    n_mutable_ohe_members = sum(
+        len(g) for g in lcld_schema.ohe_groups() if lcld_schema.mutable[g[0]]
+    )
+    expected = int(lcld_schema.mutable.sum()) - n_mutable_ohe_members + 1
+    assert codec.gen_length == expected
+    assert codec.n_groups == 1
+
+
+def test_roundtrip_ml_genetic(lcld_schema):
+    codec = make_codec(lcld_schema)
+    x = synth_lcld(32, lcld_schema, seed=1)
+    x_gen = C.ml_to_genetic(codec, jnp.asarray(x))
+    x_back = C.genetic_to_ml(codec, x_gen, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(x_back), x, rtol=0, atol=1e-12)
+
+
+def test_genetic_to_ml_keeps_immutables(lcld_schema):
+    codec = make_codec(lcld_schema)
+    x = synth_lcld(8, lcld_schema, seed=2)
+    x_gen = C.ml_to_genetic(codec, jnp.asarray(x))
+    # Perturb all genes; immutable ML features must not move.
+    x_gen2 = x_gen + 0.37
+    x_ml2 = np.asarray(C.genetic_to_ml(codec, x_gen2, jnp.asarray(x)))
+    immutable = ~lcld_schema.mutable
+    np.testing.assert_array_equal(x_ml2[:, immutable], x[:, immutable])
+
+
+def test_ohe_validity_by_construction(lcld_schema):
+    codec = make_codec(lcld_schema)
+    x = synth_lcld(8, lcld_schema, seed=3)
+    x_gen = C.ml_to_genetic(codec, jnp.asarray(x))
+    # Push categorical gene through its full range: decoded group stays one-hot.
+    mutable_groups = [
+        g for g in lcld_schema.ohe_groups() if lcld_schema.mutable[g[0]]
+    ]
+    for cat in range(len(mutable_groups[0])):
+        x_gen2 = x_gen.at[:, -1].set(float(cat))
+        x_ml2 = np.asarray(C.genetic_to_ml(codec, x_gen2, jnp.asarray(x)))
+        group = mutable_groups[0]
+        np.testing.assert_allclose(x_ml2[:, group].sum(axis=1), 1.0)
+        assert np.all(x_ml2[:, group[cat]] == 1.0)
+
+
+def test_genetic_bounds(lcld_schema):
+    codec = make_codec(lcld_schema)
+    xl_ml, xu_ml = lcld_schema.bounds()
+    xl, xu = C.genetic_bounds(codec, xl_ml, xu_ml)
+    assert xl.shape == (codec.gen_length,)
+    assert np.all(np.asarray(xu) >= np.asarray(xl))
+    # categorical gene bound = group size - 1 (purpose group: 14 members)
+    assert float(xu[-1]) == 13.0
+
+
+def test_minmax_semantics():
+    xl = jnp.asarray([0.0, 5.0, 2.0])
+    xu = jnp.asarray([1.0, 5.0, 4.0])  # middle feature degenerate
+    x = jnp.asarray([[0.5, 5.0, 3.0]])
+    norm = np.asarray(C.minmax_normalize(x, xl, xu))
+    np.testing.assert_allclose(norm, [[0.5, 0.0, 0.5]])
+    back = np.asarray(C.minmax_denormalize(jnp.asarray(norm), xl, xu))
+    np.testing.assert_allclose(back, np.asarray(x))
+
+
+def test_ohe_distance(lcld_schema):
+    codec = make_codec(lcld_schema)
+    x = synth_lcld(4, lcld_schema, seed=4)
+    d0 = np.asarray(C.ohe_distance(codec, jnp.asarray(x)))
+    np.testing.assert_allclose(d0, 0.0, atol=1e-12)
+    # Break one OHE member -> distance grows by that amount.
+    group = [g for g in lcld_schema.ohe_groups() if lcld_schema.mutable[g[0]]][0]
+    x2 = x.copy()
+    x2[:, group] = 0.0
+    d2 = np.asarray(C.ohe_distance(codec, jnp.asarray(x2)))
+    np.testing.assert_allclose(d2, 1.0, atol=1e-12)

@@ -1,0 +1,1 @@
+"""Attack engines: evolutionary (MoEvA2), gradient (PGD/AutoPGD), MIP (SAT)."""

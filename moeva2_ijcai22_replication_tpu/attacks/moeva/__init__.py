@@ -1,0 +1,3 @@
+from .engine import Moeva2, MoevaResult
+
+__all__ = ["Moeva2", "MoevaResult"]

@@ -208,7 +208,9 @@ def genetic_bounds(codec: Codec, xl_ml: jnp.ndarray, xu_ml: jnp.ndarray):
     Parity: ``FeatureEncoder.get_min_max_genetic`` (``feature_encoder.py:145-163``):
     categorical genes range over [0, group_size - 1].
     """
-    xl_ml = jnp.asarray(xl_ml, dtype=jnp.result_type(float))
+    xl_ml = jnp.asarray(xl_ml)
+    if not jnp.issubdtype(xl_ml.dtype, jnp.floating):
+        xl_ml = xl_ml.astype(jnp.result_type(float))
     xu_ml = jnp.asarray(xu_ml, dtype=xl_ml.dtype)
     batch = xl_ml.shape[:-1]
     cat_lo = jnp.broadcast_to(

@@ -44,6 +44,18 @@ class ConstraintSet:
     def __init__(self, schema: FeatureSchema, bounds: ConstraintBounds | None = None):
         self.schema = schema
         self.constraint_bounds = bounds
+        self._norm_cmin = None
+        self._norm_inv_rng = None
+        if bounds is not None:
+            if self.n_constraints and bounds.n_constraints != self.n_constraints:
+                raise ValueError(
+                    f"{type(self).__name__} defines {self.n_constraints} constraints "
+                    f"but the constraint-bounds file has {bounds.n_constraints} rows "
+                    "(base vs augmented constraints.csv mix-up?)"
+                )
+            rng = np.asarray(bounds.cmax) - np.asarray(bounds.cmin)
+            self._norm_cmin = jnp.asarray(bounds.cmin)
+            self._norm_inv_rng = jnp.asarray(1.0 / np.where(rng == 0, 1.0, rng))
 
     # -- to implement ------------------------------------------------------
     def _raw(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -70,10 +82,7 @@ class ConstraintSet:
     def normalise(self, g: jnp.ndarray) -> jnp.ndarray:
         if self.constraint_bounds is None:
             return g
-        cmin = jnp.asarray(self.constraint_bounds.cmin)
-        rng = jnp.asarray(self.constraint_bounds.cmax) - cmin
-        rng = jnp.where(rng == 0, 1.0, rng)
-        return (g - cmin) / rng
+        return (g - self._norm_cmin) * self._norm_inv_rng
 
     def check_constraints_error(self, x: np.ndarray) -> None:
         """Raise if any sample violates any constraint.

@@ -101,7 +101,13 @@ class LcldConstraints(ConstraintSet):
 
         x = harden_onehot(x, self._ohe_idx, self._ohe_mask)
 
-        if x.shape[-1] > N_BASE_FEATURES and self.important_features is not None:
+        if x.shape[-1] > N_BASE_FEATURES:
+            if self.important_features is None:
+                raise FileNotFoundError(
+                    "repair() on augmented inputs requires important_features.npy "
+                    "to re-derive the XOR features (otherwise they would be left "
+                    "stale and constraint-violating)"
+                )
             base = x[..., : -augmentation.n_pairs(self.important_features)]
             x = augmentation.augment(base, self.important_features)
         return x

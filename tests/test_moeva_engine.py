@@ -1,0 +1,155 @@
+"""End-to-end MoEvA2 engine tests on synthetic LCLD fixtures (small budgets)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import lcld_mlp, init_params
+
+
+@pytest.fixture(scope="module")
+def lcld_constraints(lcld_paths):
+    return LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+
+
+@pytest.fixture(scope="module")
+def surrogate(lcld_constraints):
+    model = lcld_mlp()
+    params = init_params(model, lcld_constraints.schema.n_features, seed=7)
+    return Surrogate(model=model, params=params)
+
+
+def _scaler_for(x):
+    # The reference always scales classifier inputs (scaler.joblib); an
+    # unscaled random MLP saturates its softmax to exact 0/1 and the attack
+    # has no gradient signal to exploit.
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    return fit_minmax(x.min(0), x.max(0))
+
+
+@pytest.fixture(scope="module")
+def attack_result(lcld_constraints, surrogate):
+    x = synth_lcld(4, lcld_constraints.schema, seed=3)
+    lcld_constraints.check_constraints_error(x)
+    moeva = Moeva2(
+        classifier=surrogate,
+        constraints=lcld_constraints,
+        ml_scaler=_scaler_for(x),
+        norm=2,
+        n_gen=6,
+        n_pop=20,
+        n_offsprings=10,
+        seed=11,
+        dtype=jnp.float64,
+    )
+    return moeva, moeva.generate(x, minimize_class=1)
+
+
+class TestMoevaEngine:
+    def test_shapes(self, attack_result, lcld_constraints):
+        moeva, res = attack_result
+        d = lcld_constraints.schema.n_features
+        assert res.x_gen.shape == (4, moeva.pop_size, moeva.codec.gen_length)
+        assert res.f.shape == (4, moeva.pop_size, 3)
+        assert res.x_ml.shape == (4, moeva.pop_size, d)
+        assert moeva.pop_size == 23  # n_pop ref points + 3 extremes
+
+    def test_immutables_unchanged(self, attack_result, lcld_constraints):
+        _, res = attack_result
+        immutable = ~lcld_constraints.schema.mutable
+        np.testing.assert_allclose(
+            res.x_ml[:, :, immutable],
+            np.broadcast_to(
+                res.x_initial[:, None, immutable], res.x_ml[:, :, immutable].shape
+            ),
+        )
+
+    def test_bounds_respected(self, attack_result, lcld_constraints):
+        _, res = attack_result
+        xl, xu = lcld_constraints.get_feature_min_max(dynamic_input=res.x_initial)
+        xl = np.broadcast_to(np.asarray(xl), res.x_initial.shape)
+        xu = np.broadcast_to(np.asarray(xu), res.x_initial.shape)
+        mutable = lcld_constraints.schema.mutable
+        x = res.x_ml[:, :, mutable]
+        lo = xl[:, None, mutable]
+        hi = xu[:, None, mutable]
+        assert (x >= lo - 1e-9).all()
+        assert (x <= hi + 1e-9).all()
+
+    def test_onehot_validity(self, attack_result, lcld_constraints):
+        _, res = attack_result
+        for group in lcld_constraints.schema.ohe_groups():
+            vals = res.x_ml[:, :, group]
+            assert set(np.unique(vals)) <= {0.0, 1.0}
+            np.testing.assert_allclose(vals.sum(-1), 1.0)
+
+    def test_int_features_integral(self, attack_result, lcld_constraints):
+        _, res = attack_result
+        int_feats = [
+            i
+            for i, t in enumerate(lcld_constraints.schema.types)
+            if str(t) == "int" and lcld_constraints.schema.mutable[i]
+        ]
+        vals = res.x_ml[:, :, int_feats]
+        np.testing.assert_allclose(vals, np.round(vals))
+
+    def test_objectives_sane(self, attack_result):
+        _, res = attack_result
+        assert np.isfinite(res.f).all()
+        assert (res.f[..., 0] >= 0).all() and (res.f[..., 0] <= 1).all()  # prob
+        assert (res.f[..., 1] >= 0).all()  # distance
+        assert (res.f[..., 2] >= 0).all()  # violations
+
+    def test_evolution_moves_population(self, attack_result):
+        _, res = attack_result
+        # after 5 mating rounds some candidates must differ from the initial
+        diff = np.abs(res.x_ml - res.x_initial[:, None, :]).max(-1)
+        assert (diff > 0).any(axis=1).all()  # every state explored
+
+    def test_deterministic(self, attack_result, lcld_constraints, surrogate):
+        moeva, res = attack_result
+        x = res.x_initial
+        moeva2 = Moeva2(
+            classifier=surrogate,
+            constraints=lcld_constraints,
+            ml_scaler=_scaler_for(x),
+            norm=2,
+            n_gen=6,
+            n_pop=20,
+            n_offsprings=10,
+            seed=11,
+            dtype=jnp.float64,
+        )
+        res2 = moeva2.generate(x, minimize_class=1)
+        np.testing.assert_allclose(res.x_gen, res2.x_gen)
+        np.testing.assert_allclose(res.f, res2.f)
+
+
+class TestMoevaSharded:
+    def test_mesh_sharded_states(self, lcld_constraints, surrogate):
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        assert len(devices) == 8, "conftest must force 8 virtual devices"
+        mesh = Mesh(np.array(devices), ("states",))
+        x = synth_lcld(8, lcld_constraints.schema, seed=5)
+        moeva = Moeva2(
+            classifier=surrogate,
+            constraints=lcld_constraints,
+            ml_scaler=_scaler_for(x),
+            norm=2,
+            n_gen=3,
+            n_pop=10,
+            n_offsprings=6,
+            seed=1,
+            mesh=mesh,
+        )
+        res = moeva.generate(x, minimize_class=1)
+        assert res.x_gen.shape[0] == 8
+        assert np.isfinite(res.f).all()

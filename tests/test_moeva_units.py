@@ -1,0 +1,298 @@
+"""Unit tests for the MoEvA2 building blocks (refdirs, NDS, survival, operators)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import nds, operators, refdirs, survival
+
+
+class TestRefDirs:
+    def test_energy_on_simplex(self):
+        dirs = refdirs.energy_ref_dirs(3, 50, seed=1)
+        assert dirs.shape == (50, 3)
+        assert np.allclose(dirs.sum(1), 1.0, atol=1e-5)
+        assert (dirs >= 0).all()
+
+    def test_energy_well_spaced(self):
+        dirs = refdirs.energy_ref_dirs(3, 30, seed=1)
+        d = np.linalg.norm(dirs[:, None] - dirs[None, :], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        # nearest-neighbour distances should be fairly uniform for an
+        # energy-minimising layout
+        nn = d.min(1)
+        assert nn.min() > 0.3 * nn.max()
+
+    def test_das_dennis_centroid(self):
+        assert np.allclose(refdirs.das_dennis(3, 1), [[1 / 3, 1 / 3, 1 / 3]])
+
+    def test_geometry_pop_size(self):
+        dirs, pop_size = refdirs.rnsga3_geometry(3, 20)
+        # pymoo: pop = n_ref_points * pop_per_ref_point + n_obj
+        assert pop_size == 23
+        assert dirs.shape == (23, 3)
+
+    def test_aspiration_projection(self):
+        pts = np.array([[0.2, 0.2, 0.2], [1.0, 0.0, 0.0]])
+        dirs = refdirs.aspiration_ref_dirs(pts)
+        # first: projection onto simplex = (1/3, 1/3, 1/3)
+        assert np.allclose(dirs[0], [1 / 3, 1 / 3, 1 / 3], atol=1e-12)
+        # extremes appended
+        assert np.allclose(dirs[-3:], np.eye(3))
+
+
+class TestNDS:
+    def test_simple_fronts(self):
+        f = jnp.array(
+            [
+                [0.0, 0.0],  # dominates everything
+                [1.0, 1.0],
+                [0.5, 1.5],
+                [2.0, 2.0],  # dominated by all but [0.5, 1.5]? no: 1,1 dominates
+            ]
+        )
+        ranks = np.asarray(nds.nd_ranks(f))
+        assert ranks[0] == 0
+        assert ranks[1] == 1
+        assert ranks[2] == 1  # incomparable with [1,1]
+        assert ranks[3] == 2
+
+    def test_all_equal_one_front(self):
+        f = jnp.ones((5, 3))
+        assert (np.asarray(nds.nd_ranks(f)) == 0).all()
+
+    def test_batched_matches_single(self):
+        key = jax.random.PRNGKey(0)
+        f = jax.random.uniform(key, (4, 20, 3))
+        batched = np.asarray(nds.nd_ranks(f))
+        for i in range(4):
+            single = np.asarray(nds.nd_ranks(f[i]))
+            np.testing.assert_array_equal(batched[i], single)
+
+    def test_against_bruteforce(self):
+        rng = np.random.default_rng(3)
+        f = rng.random((30, 3))
+        ranks = np.asarray(nds.nd_ranks(jnp.asarray(f)))
+
+        def brute(f):
+            n = len(f)
+            dom = np.zeros((n, n), bool)
+            for i in range(n):
+                for j in range(n):
+                    dom[i, j] = (f[i] <= f[j]).all() and (f[i] < f[j]).any()
+            ranks = np.full(n, -1)
+            r = 0
+            remaining = np.ones(n, bool)
+            while remaining.any():
+                front = remaining & ~(dom & remaining[:, None]).any(0)
+                ranks[front] = r
+                remaining &= ~front
+                r += 1
+            return ranks
+
+        np.testing.assert_array_equal(ranks, brute(f))
+
+
+class TestSurvival:
+    def test_select_count_and_elitism(self):
+        key = jax.random.PRNGKey(0)
+        f = jax.random.uniform(jax.random.PRNGKey(1), (40, 3))
+        asp = jnp.asarray(refdirs.energy_ref_dirs(3, 10, seed=1), jnp.float32)
+        state = survival.NormState.init(3)
+        mask, new_state, ranks = survival.survive(key, f, asp, state, 13)
+        mask, ranks = np.asarray(mask), np.asarray(ranks)
+        assert mask.sum() == 13
+        # elitism: any selected candidate's rank <= any unselected's rank
+        assert ranks[mask].max() <= ranks[~mask].min() or (
+            ranks[mask].max() == ranks[~mask].min()
+        )
+        # fronts below the splitting front survive entirely
+        split = ranks[mask].max()
+        assert mask[ranks < split].all()
+        # ideal point updated
+        np.testing.assert_allclose(
+            np.asarray(new_state.ideal), np.asarray(f).min(0), rtol=1e-6
+        )
+
+    def test_survive_all_when_exact_fit(self):
+        key = jax.random.PRNGKey(0)
+        f = jax.random.uniform(jax.random.PRNGKey(2), (10, 3))
+        asp = jnp.asarray(refdirs.energy_ref_dirs(3, 5, seed=1), jnp.float32)
+        mask, _, _ = survival.survive(key, f, asp, survival.NormState.init(3), 10)
+        assert np.asarray(mask).all()
+
+    def test_norm_state_persists_ideal(self):
+        asp = jnp.asarray(refdirs.energy_ref_dirs(3, 5, seed=1), jnp.float32)
+        st = survival.NormState.init(3)
+        f1 = jnp.ones((8, 3)) * 5.0
+        _, st, _ = survival.survive(jax.random.PRNGKey(0), f1, asp, st, 8)
+        f2 = jnp.ones((8, 3)) * 9.0
+        _, st, _ = survival.survive(jax.random.PRNGKey(1), f2, asp, st, 8)
+        np.testing.assert_allclose(np.asarray(st.ideal), 5.0)
+        np.testing.assert_allclose(np.asarray(st.worst), 9.0)
+
+    def test_niching_prefers_spread(self):
+        # 1 crowded niche vs empty niches: niching should pick from empties.
+        asp = jnp.asarray(np.eye(3, dtype=np.float32) * 0.9 + 0.05)
+        # 3 clusters along the axes; all mutually non-dominated
+        f = jnp.asarray(
+            np.array(
+                [[0.01, 1.0, 1.0]] * 6  # cluster at axis 0
+                + [[1.0, 0.01, 1.0]] * 2
+                + [[1.0, 1.0, 0.01]] * 2,
+                dtype=np.float32,
+            )
+        )
+        mask, _, _ = survival.survive(
+            jax.random.PRNGKey(0), f, asp, survival.NormState.init(3), 6
+        )
+        mask = np.asarray(mask)
+        # both small clusters must be represented
+        assert mask[6:8].any()
+        assert mask[8:10].any()
+
+
+class TestOperators:
+    def _tables(self, int_mask):
+        from moeva2_ijcai22_replication_tpu.core.codec import Codec
+
+        int_mask = np.asarray(int_mask, bool)
+        length = len(int_mask)
+        codec = Codec(
+            non_ohe_ml_idx=jnp.arange(length, dtype=jnp.int32),
+            group_ml_idx=jnp.zeros((0, 1), jnp.int32),
+            group_pad_mask=jnp.zeros((0, 1), bool),
+            group_sizes=jnp.zeros((0,), jnp.int32),
+            int_mask_gen=jnp.asarray(int_mask),
+            mutable_mask=jnp.ones((length,), bool),
+            n_features=length,
+            gen_length=length,
+        )
+        return operators.make_operator_tables(codec)
+
+    def test_tables(self):
+        t = self._tables([False, True, False, True, True])
+        np.testing.assert_array_equal(np.asarray(t.type_sizes), [2, 3])
+        np.testing.assert_array_equal(np.asarray(t.rank_in_type), [0, 0, 1, 1, 2])
+        np.testing.assert_allclose(
+            np.asarray(t.mut_prob), [1 / 2, 1 / 3, 1 / 2, 1 / 3, 1 / 3]
+        )
+
+    def test_crossover_preserves_multiset(self):
+        t = self._tables([False] * 6 + [True] * 4)
+        key = jax.random.PRNGKey(0)
+        p1 = jnp.arange(10.0)[None, :].repeat(32, 0)
+        p2 = (jnp.arange(10.0) + 100)[None, :].repeat(32, 0)
+        c1, c2 = operators.two_point_crossover(key, t, p1, p2, prob=1.0)
+        # each gene slot holds the pair {i, i+100} across the two children
+        np.testing.assert_allclose(np.asarray(c1 + c2), np.asarray(p1 + p2))
+        # some but not all genes swapped in at least one mating
+        swapped = np.asarray(c1 != p1)
+        assert swapped.any() and not swapped.all()
+
+    def test_crossover_segments_contiguous_per_type(self):
+        t = self._tables([False] * 8)
+        key = jax.random.PRNGKey(1)
+        p1 = jnp.zeros((64, 8))
+        p2 = jnp.ones((64, 8))
+        c1, _ = operators.two_point_crossover(key, t, p1, p2, prob=1.0)
+        swaps = np.asarray(c1) == 1.0
+        for row in swaps:
+            # a contiguous run: at most 2 transitions in the 0/1 pattern
+            assert (np.abs(np.diff(row.astype(int))) != 0).sum() <= 2
+
+    def test_mutation_bounds_and_ints(self):
+        t = self._tables([False] * 5 + [True] * 5)
+        xl = jnp.zeros(10)
+        xu = jnp.full((10,), 10.0)
+        x = jnp.full((200, 10), 5.0)
+        y = operators.polynomial_mutation(jax.random.PRNGKey(0), t, x, xl, xu)
+        y = np.asarray(y)
+        assert (y >= 0).all() and (y <= 10).all()
+        assert np.allclose(y[:, 5:], np.round(y[:, 5:]))
+        assert (y != 5.0).any()  # something mutated
+
+    def test_mutation_zero_range_untouched(self):
+        t = self._tables([False] * 4)
+        xl = xu = jnp.full((4,), 3.0)
+        x = jnp.full((50, 4), 3.0)
+        y = operators.polynomial_mutation(jax.random.PRNGKey(0), t, x, xl, xu)
+        np.testing.assert_allclose(np.asarray(y), 3.0)
+
+    def test_offspring_shape(self):
+        t = self._tables([False] * 3 + [True] * 2)
+        pop = jax.random.uniform(jax.random.PRNGKey(0), (20, 5)) * 10
+        off = operators.make_offspring(
+            jax.random.PRNGKey(1), t, pop, jnp.zeros(5), jnp.full((5,), 10.0), 7
+        )
+        assert off.shape == (7, 5)
+
+
+class TestReviewRegressions:
+    """Regressions for the code-review findings on the first engine version."""
+
+    def test_survival_exact_front_fit(self):
+        # front 0 has exactly n_survive members; fronts beyond must not leak in
+        rng = np.random.default_rng(0)
+        nd = rng.random((13, 3))
+        dominated = nd + 1.0  # strictly worse than every nd point
+        f = jnp.asarray(np.concatenate([nd, dominated[:27 - 13]]), jnp.float32)
+        asp = jnp.asarray(refdirs.energy_ref_dirs(3, 10, seed=1), jnp.float32)
+        mask, _, ranks = survival.survive(
+            jax.random.PRNGKey(0), f, asp, survival.NormState.init(3), 13
+        )
+        mask = np.asarray(mask)
+        assert mask.sum() == 13
+        assert mask[:13].all()
+
+    def test_two_gene_subvector_swaps(self):
+        # pymoo pads cuts with n_var: a 2-gene sub-vector always swaps gene 1
+        from moeva2_ijcai22_replication_tpu.core.codec import Codec
+
+        int_mask = np.array([False, False])
+        codec = Codec(
+            non_ohe_ml_idx=jnp.arange(2, dtype=jnp.int32),
+            group_ml_idx=jnp.zeros((0, 1), jnp.int32),
+            group_pad_mask=jnp.zeros((0, 1), bool),
+            group_sizes=jnp.zeros((0,), jnp.int32),
+            int_mask_gen=jnp.asarray(int_mask),
+            mutable_mask=jnp.ones((2,), bool),
+            n_features=2,
+            gen_length=2,
+        )
+        t = operators.make_operator_tables(codec)
+        p1 = jnp.zeros((64, 2))
+        p2 = jnp.ones((64, 2))
+        c1, _ = operators.two_point_crossover(jax.random.PRNGKey(0), t, p1, p2, prob=1.0)
+        c1 = np.asarray(c1)
+        assert (c1[:, 1] == 1.0).all()  # second gene always swapped
+        assert (c1[:, 0] == 0.0).all()  # first gene never swapped
+
+    def test_crossover_types_gate_independently(self):
+        t = None
+        from moeva2_ijcai22_replication_tpu.core.codec import Codec
+
+        int_mask = np.array([False] * 5 + [True] * 5)
+        codec = Codec(
+            non_ohe_ml_idx=jnp.arange(10, dtype=jnp.int32),
+            group_ml_idx=jnp.zeros((0, 1), jnp.int32),
+            group_pad_mask=jnp.zeros((0, 1), bool),
+            group_sizes=jnp.zeros((0,), jnp.int32),
+            int_mask_gen=jnp.asarray(int_mask),
+            mutable_mask=jnp.ones((10,), bool),
+            n_features=10,
+            gen_length=10,
+        )
+        t = operators.make_operator_tables(codec)
+        p1 = jnp.zeros((512, 10))
+        p2 = jnp.ones((512, 10))
+        c1, _ = operators.two_point_crossover(jax.random.PRNGKey(3), t, p1, p2, prob=0.5)
+        c1 = np.asarray(c1)
+        real_crossed = (c1[:, :5] == 1.0).any(1)
+        int_crossed = (c1[:, 5:] == 1.0).any(1)
+        # with independent 0.5 coins, all four combinations must appear
+        assert (real_crossed & ~int_crossed).any()
+        assert (~real_crossed & int_crossed).any()
+        assert (real_crossed & int_crossed).any()
+        assert (~real_crossed & ~int_crossed).any()

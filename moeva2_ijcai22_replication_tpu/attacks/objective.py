@@ -1,0 +1,150 @@
+"""Post-hoc attack evaluation: the paper's o1..o7 success metrics.
+
+Reference parity (``/root/reference/src/attacks/moeva2/objective_calculator.py``):
+
+- per candidate: ``[constraint_violation, f1, f2]`` where constraint_violation
+  sums the domain violations plus the one-hot distance over ALL OHE groups
+  (``:44-57``; ``moeva2/utils.py:43-54``), f1 = P(minimize_class), f2 = the
+  *unscaled* Lp distance in min-max-scaled feature space (``:59-82``);
+- o1..o7 = C, M, D, C∧M, C∧D, M∧D, C∧M∧D against thresholds
+  {f1: misclassification, f2: ε} (``:86-100``);
+- ``success_rate_3d``: fraction of initial states with ≥1 qualifying candidate
+  in their population, per column (``:106-119``);
+- ``get_successful_attacks``: best successful candidate(s) per state sorted by
+  misclassification or distance (``:150-223``) — feeds adversarial retraining.
+
+TPU-first: the whole (states x population) tensor is evaluated as one jitted
+program with a single device→host reduction, instead of the reference's
+per-state Python loop over joblib threads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.codec import all_ohe_groups_distance, full_ohe_tables
+from ..core.constraints import ConstraintSet
+from ..models.io import Surrogate
+from ..models.scalers import MinMaxParams
+
+O_COLUMNS = ("o1", "o2", "o3", "o4", "o5", "o6", "o7")
+
+
+@dataclass
+class ObjectiveCalculator:
+    classifier: Surrogate
+    constraints: ConstraintSet
+    thresholds: dict  # {"f1": misclassification threshold, "f2": eps}
+    min_max_scaler: MinMaxParams
+    minimize_class: int = 1
+    norm: Any = np.inf
+    ml_scaler: MinMaxParams | None = None
+
+    def __post_init__(self):
+        self._ohe_idx, self._ohe_mask = full_ohe_tables(self.constraints.schema)
+        self._jit_objectives = jax.jit(self._objectives)
+
+    # -- kernels ------------------------------------------------------------
+    def _objectives(self, params, x_initial, x_f):
+        """``x_initial`` (..., D), ``x_f`` (..., P, D) -> (..., P, 3)
+        columns [constraint_violation, f1, f2]."""
+        g = self.constraints.evaluate(x_f)  # already clipped at 0
+        ohe = all_ohe_groups_distance(self._ohe_idx, self._ohe_mask, x_f)
+        cv = g.sum(-1) + ohe
+
+        x_ml = self.ml_scaler.transform(x_f) if self.ml_scaler is not None else x_f
+        probs = Surrogate(self.classifier.model, params).predict_proba(x_ml)
+        f1 = probs[..., self.minimize_class]
+
+        xi = self.min_max_scaler.transform(x_initial)[..., None, :]
+        xs = self.min_max_scaler.transform(x_f)
+        diff = xi - xs
+        if self.norm in (np.inf, "inf", "linf"):
+            f2 = jnp.abs(diff).max(-1)
+        elif self.norm in (2, "2"):
+            f2 = jnp.sqrt((diff * diff).sum(-1))
+        else:
+            raise NotImplementedError(f"Unsupported norm: {self.norm!r}")
+        # scalar range stats only — the host assert must not pull the full
+        # scaled tensors off device
+        range_lo = jnp.minimum(xi.min(), xs.min())
+        range_hi = jnp.maximum(xi.max(), xs.max())
+        return jnp.stack([cv, f1, f2], axis=-1), (range_lo, range_hi)
+
+    def objectives(self, x_initial: np.ndarray, x_f: np.ndarray) -> np.ndarray:
+        """[cv, f1, f2] per candidate; scaling-range asserts mirror
+        ``objective_calculator.py:72-76``."""
+        vals, (lo, hi) = self._jit_objectives(
+            self.classifier.params, jnp.asarray(x_initial), jnp.asarray(x_f)
+        )
+        tol = 1e-4
+        if not (float(lo) >= -tol and float(hi) <= 1 + tol):
+            raise AssertionError(
+                "min-max scaled values outside [0,1]: wrong scaler for this data?"
+            )
+        return np.asarray(vals)
+
+    def respected(self, objective_values: np.ndarray) -> np.ndarray:
+        """o1..o7 booleans from [cv, f1, f2] (parity ``:86-100``)."""
+        c = objective_values[..., 0] <= 0
+        m = objective_values[..., 1] < self.thresholds["f1"]
+        d = objective_values[..., 2] <= self.thresholds["f2"]
+        return np.stack([c, m, d, c & m, c & d, m & d, c & m & d], axis=-1)
+
+    # -- success rates ------------------------------------------------------
+    def success_rate(self, x_initial: np.ndarray, x_f: np.ndarray) -> np.ndarray:
+        """Mean of each o-column over one state's population (``:102-104``)."""
+        return self.respected(self.objectives(x_initial, x_f)).mean(axis=-2)
+
+    def at_least_one(self, x_initial, x_f) -> np.ndarray:
+        return self.success_rate(x_initial, x_f) > 0
+
+    def success_rate_3d(self, x_initial: np.ndarray, x: np.ndarray) -> np.ndarray:
+        """(7,) fraction of states with ≥1 qualifying candidate (``:106-119``)."""
+        o = self.respected(self.objectives(np.asarray(x_initial), np.asarray(x)))
+        return o.any(axis=1).mean(axis=0)
+
+    def success_rate_3d_df(self, x_initial, x):
+        import pandas as pd
+
+        rates = self.success_rate_3d(x_initial, x)
+        return pd.DataFrame(rates.reshape(1, -1), columns=list(O_COLUMNS))
+
+    # -- successful-attack extraction ---------------------------------------
+    def get_successful_attacks(
+        self,
+        x_initials: np.ndarray,  # (S, D)
+        x_generated: np.ndarray,  # (S, P, D)
+        preferred_metrics: str = "misclassification",
+        order: str = "asc",
+        max_inputs: int = -1,
+        return_index_success: bool = False,
+    ):
+        """Best o7-successful candidates per state, sorted by the preferred
+        metric (parity ``:150-223``; the reference caps to 1 whenever
+        max_inputs > -1 — here max_inputs is honoured as a true cap).
+        """
+        metric_col = {"misclassification": 1, "distance": 2}[preferred_metrics]
+        vals = self.objectives(np.asarray(x_initials), np.asarray(x_generated))
+        ok = self.respected(vals)[..., -1]  # (S, P) o7
+
+        out, index_success = [], []
+        for i in range(vals.shape[0]):
+            idx = np.argsort(vals[i, :, metric_col], kind="stable")
+            if order == "desc":
+                idx = idx[::-1]
+            idx = idx[ok[i, idx]]
+            if max_inputs > -1:
+                idx = idx[:max_inputs]
+            out.append(np.asarray(x_generated)[i, idx])
+            index_success.append(len(idx) >= 1)
+        successful = np.concatenate(out, axis=0)
+        if return_index_success:
+            return successful, np.array(index_success)
+        return successful

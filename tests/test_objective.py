@@ -1,0 +1,125 @@
+"""ObjectiveCalculator tests: o1..o7 semantics vs an independent numpy oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.objective import ObjectiveCalculator
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import lcld_mlp, init_params
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+
+@pytest.fixture(scope="module")
+def setup(lcld_paths):
+    cons = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=2))
+    x = synth_lcld(6, cons.schema, seed=9)
+    # scaler over data range so scaled values live in [0, 1]
+    scaler = fit_minmax(x.min(0), x.max(0))
+    calc = ObjectiveCalculator(
+        classifier=sur,
+        constraints=cons,
+        thresholds={"f1": 0.5, "f2": 0.2},
+        min_max_scaler=scaler,
+        minimize_class=1,
+        norm=2,
+        ml_scaler=scaler,
+    )
+    return cons, sur, x, scaler, calc
+
+
+class TestObjectives:
+    def test_valid_candidates_have_zero_cv(self, setup):
+        cons, _, x, _, calc = setup
+        pops = np.repeat(x[:, None, :], 3, axis=1)  # population = initial state
+        vals = calc.objectives(x, pops)
+        np.testing.assert_allclose(vals[..., 0], 0.0)  # constraints hold
+        np.testing.assert_allclose(vals[..., 2], 0.0, atol=1e-12)  # zero distance
+
+    def test_oracle_parity(self, setup):
+        cons, sur, x, scaler, calc = setup
+        rng = np.random.default_rng(0)
+        pops = np.repeat(x[:, None, :], 4, axis=1)
+        # perturb mutable real features only
+        mutable = np.asarray(cons.schema.mutable)
+        real = np.array([str(t) == "real" for t in cons.schema.types]) & mutable
+        noise = rng.normal(0, 0.05, pops.shape) * pops
+        pops[..., real] += noise[..., real]
+        # keep inside the fitted scaler range so the [0,1] assert holds
+        pops = np.clip(pops, x.min(0), x.max(0))
+
+        vals = calc.objectives(x, pops)
+
+        # independent numpy oracle
+        import jax
+
+        g = np.asarray(cons.evaluate(jnp.asarray(pops)))
+        ohe_masks = cons.schema.ohe_groups()
+        ohe_d = sum(np.abs(1 - pops[..., m].sum(-1)) for m in ohe_masks)
+        cv = g.sum(-1) + ohe_d
+        np.testing.assert_allclose(vals[..., 0], cv, rtol=1e-6)
+
+        sc = lambda a: np.asarray(a) * np.asarray(scaler.scale) + np.asarray(scaler.min_)
+        probs = np.asarray(sur.predict_proba(jnp.asarray(sc(pops))))
+        np.testing.assert_allclose(vals[..., 1], probs[..., 1], rtol=1e-5)
+
+        f2 = np.linalg.norm(sc(x)[:, None, :] - sc(pops), ord=2, axis=-1)
+        np.testing.assert_allclose(vals[..., 2], f2, rtol=1e-5, atol=1e-8)
+
+    def test_o_columns_logic(self, setup):
+        *_, calc = setup
+        vals = np.array(
+            [
+                [[0.0, 0.1, 0.1]],  # C, M, D all hold
+                [[1.0, 0.1, 0.1]],  # M, D
+                [[0.0, 0.9, 0.1]],  # C, D
+                [[0.0, 0.1, 0.9]],  # C, M
+            ]
+        )
+        o = calc.respected(vals)
+        np.testing.assert_array_equal(o[0, 0], [1, 1, 1, 1, 1, 1, 1])
+        np.testing.assert_array_equal(o[1, 0], [0, 1, 1, 0, 0, 1, 0])
+        np.testing.assert_array_equal(o[2, 0], [1, 0, 1, 0, 1, 0, 0])
+        np.testing.assert_array_equal(o[3, 0], [1, 1, 0, 1, 0, 0, 0])
+
+    def test_success_rate_3d_any_semantics(self, setup):
+        cons, _, x, _, calc = setup
+        pops = np.repeat(x[:, None, :], 5, axis=1)
+        rates = calc.success_rate_3d(x, pops)
+        assert rates.shape == (7,)
+        # identical-to-initial populations: constraints + distance hold
+        assert rates[0] == 1.0  # o1 = C
+        assert rates[2] == 1.0  # o3 = D
+        assert rates[4] == 1.0  # o5 = C & D
+
+    def test_success_rate_df_columns(self, setup):
+        cons, _, x, _, calc = setup
+        pops = np.repeat(x[:, None, :], 2, axis=1)
+        df = calc.success_rate_3d_df(x, pops)
+        assert list(df.columns) == ["o1", "o2", "o3", "o4", "o5", "o6", "o7"]
+
+    def test_scaling_assert_triggers(self, setup):
+        cons, sur, x, scaler, calc = setup
+        bad = x.copy()
+        bad[:, 0] = x[:, 0].max() * 10  # way out of the scaler's range
+        pops = np.repeat(bad[:, None, :], 2, axis=1)
+        with pytest.raises(AssertionError):
+            calc.objectives(bad, pops)
+
+    def test_get_successful_attacks(self, setup):
+        cons, sur, x, scaler, calc = setup
+        pops = np.repeat(x[:, None, :], 4, axis=1)
+        vals = calc.objectives(x, pops)
+        o7 = calc.respected(vals)[..., -1]  # (S, P)
+        succ, idx = calc.get_successful_attacks(
+            x, pops, max_inputs=1, return_index_success=True
+        )
+        assert idx.shape == (len(x),)
+        assert succ.shape[0] == o7.any(1).sum()
+        # every returned attack satisfies constraints
+        if len(succ):
+            cons.check_constraints_error(succ)

@@ -30,6 +30,7 @@ import numpy as np
 from ...core import codec as codec_lib
 from ...core.codec import Codec, make_codec
 from ...core.constraints import ConstraintSet
+from ...core.norms import is_l2, lp_distance, validate_norm
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
 from .operators import OperatorTables, make_operator_tables, make_offspring
@@ -88,13 +89,12 @@ class Moeva2:
         self.asp_points = jnp.asarray(
             energy_ref_dirs(3, self.n_pop, seed=1), dtype=self.dtype
         )
-        if self.norm in (2, "2"):
-            self._f2_scale = float(np.sqrt(self.codec.n_features))
-        elif self.norm in (np.inf, "inf", "linf"):
-            self._f2_scale = 1.0
-        else:
-            # Parity: default_problem.py:87 raises for norms other than 2/inf.
-            raise NotImplementedError(f"Unsupported norm: {self.norm!r}")
+        # Parity: default_problem.py:87 raises for norms other than 2/inf;
+        # f2 scaling by sqrt(D) for L2 per get_scaler_from_norm.
+        validate_norm(self.norm)
+        self._f2_scale = (
+            float(np.sqrt(self.codec.n_features)) if is_l2(self.norm) else 1.0
+        )
         if self.save_history not in (None, False, "reduced", "full"):
             raise ValueError(
                 f"save_history must be None, 'reduced' or 'full', got {self.save_history!r}"
@@ -119,11 +119,7 @@ class Moeva2:
             probs, minimize_class[:, None, None], axis=-1
         )[..., 0]
         diff = x_mm - x_init_mm[:, None, :]
-        if self.norm in (np.inf, "inf", "linf"):
-            f2 = jnp.abs(diff).max(-1)
-        else:
-            f2 = jnp.sqrt((diff * diff).sum(-1))
-        f2 = f2 / self._f2_scale
+        f2 = lp_distance(diff, self.norm) / self._f2_scale
         g_all = self.constraints.evaluate(x_f)
         return jnp.stack([f1, f2, g_all.sum(-1)], axis=-1), g_all
 

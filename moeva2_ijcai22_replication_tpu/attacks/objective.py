@@ -30,6 +30,7 @@ import numpy as np
 
 from ..core.codec import all_ohe_groups_distance, full_ohe_tables
 from ..core.constraints import ConstraintSet
+from ..core.norms import lp_distance, validate_norm
 from ..models.io import Surrogate
 from ..models.scalers import MinMaxParams
 
@@ -47,6 +48,7 @@ class ObjectiveCalculator:
     ml_scaler: MinMaxParams | None = None
 
     def __post_init__(self):
+        validate_norm(self.norm)
         self._ohe_idx, self._ohe_mask = full_ohe_tables(self.constraints.schema)
         self._jit_objectives = jax.jit(self._objectives)
 
@@ -64,13 +66,7 @@ class ObjectiveCalculator:
 
         xi = self.min_max_scaler.transform(x_initial)[..., None, :]
         xs = self.min_max_scaler.transform(x_f)
-        diff = xi - xs
-        if self.norm in (np.inf, "inf", "linf"):
-            f2 = jnp.abs(diff).max(-1)
-        elif self.norm in (2, "2"):
-            f2 = jnp.sqrt((diff * diff).sum(-1))
-        else:
-            raise NotImplementedError(f"Unsupported norm: {self.norm!r}")
+        f2 = lp_distance(xi - xs, self.norm)
         # scalar range stats only — the host assert must not pull the full
         # scaled tensors off device
         range_lo = jnp.minimum(xi.min(), xs.min())

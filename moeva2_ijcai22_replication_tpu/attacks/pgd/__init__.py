@@ -1,0 +1,4 @@
+from .engine import ConstrainedPGD, round_ints_toward_initial
+from .autopgd import AutoPGD
+
+__all__ = ["ConstrainedPGD", "AutoPGD", "round_ints_toward_initial"]

@@ -1,0 +1,62 @@
+"""Shared Lp-norm kernels: one home for norm-membership dispatch.
+
+Consumers: the MoEvA2 objective (f2 distance), the post-hoc
+ObjectiveCalculator, and the PGD family (gradient conditioning + ε-ball
+projection). The reference spreads these across ART utilities and
+``get_scaler_from_norm`` (``moeva2/utils.py:11-22``); supported norms are
+2 and inf everywhere (``default_problem.py:80-91`` raises otherwise).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_INF_ALIASES = (np.inf, "inf", "linf")
+_L2_ALIASES = (2, "2", 2.0)
+_L1_ALIASES = (1, "1", 1.0)
+
+
+def is_inf(norm) -> bool:
+    return norm in _INF_ALIASES
+
+
+def is_l2(norm) -> bool:
+    return norm in _L2_ALIASES
+
+
+def validate_norm(norm):
+    if not (is_inf(norm) or is_l2(norm)):
+        raise NotImplementedError(f"Unsupported norm: {norm!r} (use 2 or inf)")
+    return norm
+
+
+def lp_distance(diff: jnp.ndarray, norm) -> jnp.ndarray:
+    """Per-row Lp norm over the trailing axis."""
+    if is_inf(norm):
+        return jnp.abs(diff).max(-1)
+    if is_l2(norm):
+        return jnp.sqrt((diff * diff).sum(-1))
+    raise NotImplementedError(f"Unsupported norm: {norm!r}")
+
+
+def project_ball(delta: jnp.ndarray, eps, norm) -> jnp.ndarray:
+    """Project perturbations into the ε-ball (ART ``_projection`` parity)."""
+    if is_inf(norm):
+        return jnp.clip(delta, -eps, eps)
+    if is_l2(norm):
+        nrm = jnp.sqrt((delta * delta).sum(-1, keepdims=True))
+        return delta * jnp.minimum(1.0, eps / (nrm + 1e-12))
+    raise NotImplementedError(f"Unsupported norm: {norm!r}")
+
+
+def condition_grad(grad: jnp.ndarray, norm) -> jnp.ndarray:
+    """Norm-condition gradients for the ascent step (``atk.py:239-261``)."""
+    tol = 1e-7
+    if is_inf(norm):
+        return jnp.sign(grad)
+    if norm in _L1_ALIASES:
+        return grad / (jnp.abs(grad).sum(-1, keepdims=True) + tol)
+    if is_l2(norm):
+        return grad / (jnp.sqrt((grad * grad).sum(-1, keepdims=True)) + tol)
+    raise NotImplementedError(f"Unsupported norm: {norm!r}")

@@ -1,0 +1,216 @@
+"""Constrained PGD / AutoPGD tests on synthetic LCLD against a trained MLP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.pgd import (
+    AutoPGD,
+    ConstrainedPGD,
+    round_ints_toward_initial,
+)
+from moeva2_ijcai22_replication_tpu.attacks.pgd.autopgd import checkpoint_schedule
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import lcld_mlp
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+from moeva2_ijcai22_replication_tpu.models.train import fit_mlp
+
+
+@pytest.fixture(scope="module")
+def setup(lcld_paths):
+    cons = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+    x = synth_lcld(128, cons.schema, seed=0)
+    scaler = fit_minmax(x.min(0), x.max(0))
+    xs = np.asarray(scaler.transform(jnp.asarray(x)))
+    # a separable-but-learnable synthetic label: above-median interest rate
+    y = (x[:, 2] > np.median(x[:, 2])).astype(np.int64)
+    fit = fit_mlp(lcld_mlp(), xs, y, epochs=30, batch_size=32, patience=30, seed=1)
+    sur = fit.surrogate
+    preds = np.asarray(sur.predict_proba(jnp.asarray(xs))).argmax(-1)
+    assert (preds == y).mean() > 0.8, "fixture model failed to learn"
+    return cons, x, xs, y, scaler, sur
+
+
+class TestConstrainedPGD:
+    def test_flip_attack_flips(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = ConstrainedPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.3, eps_step=0.05, max_iter=50, norm=np.inf,
+            loss_evaluation="flip",
+        )
+        adv = atk.generate(xs, y)
+        preds = np.asarray(sur.predict_proba(jnp.asarray(adv))).argmax(-1)
+        flip_rate = (preds != y).mean()
+        assert flip_rate > 0.5, f"flip rate only {flip_rate}"
+
+    def test_immutable_features_untouched(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = ConstrainedPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.05, max_iter=10, norm=np.inf,
+        )
+        adv = atk.generate(xs, y)
+        immutable = ~np.asarray(cons.schema.mutable)
+        np.testing.assert_allclose(adv[:, immutable], xs[:, immutable], atol=1e-7)
+
+    def test_eps_ball_respected(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        for norm, eps in [(np.inf, 0.1), (2, 0.5)]:
+            atk = ConstrainedPGD(
+                classifier=sur, constraints=cons, scaler=scaler,
+                eps=eps, eps_step=0.05, max_iter=12, norm=norm,
+            )
+            adv = atk.generate(xs, y)
+            delta = adv - xs
+            if norm is np.inf:
+                assert np.abs(delta).max() <= eps + 1e-5
+            else:
+                assert np.linalg.norm(delta, axis=1).max() <= eps + 1e-4
+
+    def test_constraint_loss_reduces_violations(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        # start from slightly violating points: perturb installment feature
+        xs_bad = xs.copy()
+        xs_bad[:, 3] = np.clip(xs_bad[:, 3] + 0.1, 0, 1)
+        g0 = np.asarray(
+            cons.evaluate(scaler.inverse(jnp.asarray(xs_bad)))
+        ).sum(-1)
+        atk = ConstrainedPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.3, eps_step=0.02, max_iter=60, norm=np.inf,
+            loss_evaluation="constraints",
+        )
+        adv = atk.generate(xs_bad, y)
+        g1 = np.asarray(cons.evaluate(scaler.inverse(jnp.asarray(adv)))).sum(-1)
+        assert g1.mean() < g0.mean() * 0.5, (g0.mean(), g1.mean())
+
+    def test_repair_strategy_satisfies_formula_constraints(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = ConstrainedPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.05, max_iter=8, norm=np.inf,
+            loss_evaluation="flip+repair",
+        )
+        adv = atk.generate(xs, y)
+        un = np.asarray(scaler.inverse(jnp.asarray(adv)))
+        # repair snaps term to {36, 60} and recomputes installment
+        assert set(np.unique(un[:, 1].round(3))) <= {36.0, 60.0}
+
+    def test_loss_strategies_all_run(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        for le in [
+            "flip",
+            "constraints",
+            "constraints+flip",
+            "constraints+flip+alternate",
+            "constraints+flip+constraints",
+            "constraints+flip+adaptive_eps_step",
+        ]:
+            atk = ConstrainedPGD(
+                classifier=sur, constraints=cons, scaler=scaler,
+                eps=0.1, eps_step=0.05, max_iter=4, norm=np.inf,
+                loss_evaluation=le,
+            )
+            adv = atk.generate(xs[:8], y[:8])
+            assert np.isfinite(adv).all(), le
+
+    def test_constraints_optim_variants(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        for co in ["sum", "alt_constraints", "single_constraints"]:
+            atk = ConstrainedPGD(
+                classifier=sur, constraints=cons, scaler=scaler,
+                eps=0.1, eps_step=0.05, max_iter=4, norm=np.inf,
+                loss_evaluation="constraints+flip", constraints_optim=co,
+            )
+            adv = atk.generate(xs[:8], y[:8])
+            assert np.isfinite(adv).all(), co
+
+    def test_random_restarts(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = ConstrainedPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.05, max_iter=6, norm=2,
+            num_random_init=3,
+        )
+        adv = atk.generate(xs[:16], y[:16])
+        assert np.isfinite(adv).all()
+        delta = np.linalg.norm(adv - xs[:16], axis=1)
+        assert delta.max() <= 0.2 + 1e-4
+
+
+class TestAutoPGD:
+    def test_checkpoint_schedule(self):
+        w = checkpoint_schedule(100)
+        assert w[0] == 0 and w[1] == 22
+        assert all(np.diff(w) >= 3)
+        assert w[-1] <= 100
+
+    def test_autopgd_flips(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = AutoPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.3, eps_step=0.1, max_iter=40, norm=np.inf,
+            loss_evaluation="flip",
+        )
+        adv = atk.generate(xs, y)
+        preds = np.asarray(sur.predict_proba(jnp.asarray(adv))).argmax(-1)
+        assert (preds != y).mean() > 0.4
+        delta = np.abs(adv - xs).max()
+        assert delta <= 0.3 + 1e-5
+
+    def test_autopgd_never_worse_than_start(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = AutoPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.05, max_iter=20, norm=np.inf,
+        )
+        adv = atk.generate(xs[:32], y[:32])
+        # x_best tracking: CE of returned points >= CE of initial points
+        def ce(xv):
+            logits = np.asarray(sur.logits(jnp.asarray(xv)))
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            return -np.log(p[np.arange(len(xv)), y[:32]] + 1e-12)
+
+        assert ce(adv).mean() >= ce(xs[:32]).mean() - 1e-5
+
+
+class TestIntRounding:
+    def test_directional_rounding(self):
+        types = ["real", "int", "int"]
+        x_init = np.array([[1.5, 5.0, 5.0]])
+        x_adv = np.array([[2.2, 6.7, 3.2]])
+        out = round_ints_toward_initial(x_adv, x_init, types)
+        np.testing.assert_allclose(out, [[2.2, 6.0, 4.0]])
+
+
+class TestAutoPgdReviewRegressions:
+    def test_manual_strategy_weights(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = AutoPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.1, eps_step=0.05, max_iter=4, norm=np.inf,
+            loss_evaluation="constraints+flip+manual",
+        )
+        # manual: class-only before iteration 100
+        w_class, w_cons = atk._loss_weights(jnp.int32(3), jnp.float32)
+        assert float(w_class) == 1.0 and float(w_cons) == 0.0
+        w_class, w_cons = atk._loss_weights(jnp.int32(150), jnp.float32)
+        assert float(w_class) == 0.0 and float(w_cons) == 1.0
+        adv = atk.generate(xs[:8], y[:8])
+        assert np.isfinite(adv).all()
+
+    def test_autopgd_random_restarts_run(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = AutoPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.05, max_iter=6, norm=np.inf,
+            num_random_init=2,
+        )
+        adv = atk.generate(xs[:16], y[:16])
+        assert np.isfinite(adv).all()
+        assert np.abs(adv - xs[:16]).max() <= 0.2 + 1e-5

@@ -60,14 +60,27 @@ class AutoPGD(ConstrainedPGD):
         def loss(x, i):
             return self._per_sample_loss(params, x, y, i)
 
+        # Iteration-independent objective for x_best/step-halving:
+        # phase-switching strategies produce incommensurable per-iteration
+        # losses, so best-point tracking uses static weights (the reference's
+        # ``compute_loss`` line-search mirror likewise has no iteration
+        # argument — ``classifier.py:334-412``).
+        tw_class, tw_cons = self._static_loss_weights()
+
+        def tracking_loss(x):
+            loss_class, cons = self._loss_terms(params, x, y, jnp.int32(0))
+            return tw_class * loss_class + tw_cons * (-cons)
+
         def step_to(x, grad, eta):
             z = x + eta[:, None] * grad
             z = jnp.clip(z, *self.clip)
             z = x_init + project_ball(z - x_init, self.eps, self.norm)
             return jnp.clip(z, *self.clip)
 
-        f0 = loss(x_start, jnp.int32(0))
-        eta0 = jnp.full((n,), 2.0 * self.eps_step, x_init.dtype)
+        f0 = tracking_loss(x_start)
+        # effective reference init: auto_pgd.py:441's 2*eps_step is dead,
+        # overwritten by eps_step at :459 before the loop
+        eta0 = jnp.full((n,), self.eps_step, x_init.dtype)
 
         carry0 = dict(
             x=x_start,
@@ -100,7 +113,7 @@ class AutoPGD(ConstrainedPGD):
                     self._mutable, self._repair(x_new).astype(x_new.dtype), x_new
                 )
 
-            f_new = loss(x_new, i)
+            f_new = tracking_loss(x_new)
             improved = c["improved"] + (f_new > c["f_prev"])
             better = f_new > c["f_best"]
             x_best = jnp.where(better[:, None], x_new, c["x_best"])

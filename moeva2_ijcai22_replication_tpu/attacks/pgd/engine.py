@@ -82,8 +82,8 @@ class ConstrainedPGD:
             return 0.0, 1.0
         return 1.0, 0.0  # flip
 
-    def _per_sample_loss(self, params, x, y, i):
-        """Per-sample loss the attack ASCENDS."""
+    def _loss_terms(self, params, x, y, i):
+        """Per-sample (class, constraint) loss terms, pre-weighting."""
         logits = Surrogate(self.classifier.model, params).logits(x)
         y1h = jax.nn.one_hot(y, logits.shape[-1], dtype=logits.dtype)
         loss_class = -(y1h * jax.nn.log_softmax(logits)).sum(-1)  # CE
@@ -100,7 +100,21 @@ class ConstrainedPGD:
             cons = g[..., self.ctr_id]
         else:
             cons = g.sum(-1)
+        return loss_class, cons
 
+    def _static_loss_weights(self):
+        """Iteration-independent weights: phase-switching strategies collapse
+        to the combined loss (for best-point tracking in AutoPGD)."""
+        le = self.loss_evaluation
+        if "constraints+flip" in le:
+            return 1.0, 1.0
+        if "constraints" in le:
+            return 0.0, 1.0
+        return 1.0, 0.0
+
+    def _per_sample_loss(self, params, x, y, i):
+        """Per-sample loss the attack ASCENDS."""
+        loss_class, cons = self._loss_terms(params, x, y, i)
         w_class, w_cons = self._loss_weights(i, loss_class.dtype)
         # violations must shrink while CE grows, hence the minus
         return w_class * loss_class + w_cons * (-cons)

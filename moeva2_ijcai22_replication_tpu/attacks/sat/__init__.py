@@ -1,0 +1,3 @@
+from .engine import LinearRows, SatAttack
+
+__all__ = ["SatAttack", "LinearRows"]

@@ -1,0 +1,179 @@
+"""SAT/MIP attack: provably constraint-satisfying candidates via MILP.
+
+Capability parity with the reference's Gurobi attack
+(``/root/reference/src/attacks/sat/sat.py:21-231``): per initial state a
+typed mixed-integer program — continuous/integer variables from the feature
+schema, immutability as bound fixes, an ε-box in min-max-scaled space
+(``:63-124``), domain constraints from a per-use-case builder (``:147``),
+hot start from a prior gradient attack (``:126-130``), and fallback to the
+initial state when infeasible (``:184-185``).
+
+Solver: scipy's HiGHS ``milp`` (no Gurobi license assumption). Documented
+fidelity limits vs the reference:
+
+- HiGHS is linear-only, so each domain supplies *linearised* constraint rows
+  (see ``domains/*_sat.py``); nonlinear terms are pinned at hot-start values
+  ("mode fixing" — the botnet domain is fully linear and needs none).
+- The L2 ε-ball (Gurobi pow-constraint, ``sat.py:98-124``) is inscribed by
+  the per-feature box of scaled radius ε/√D — solutions remain valid L2
+  members, the search space is just smaller.
+- Gurobi's solution pool (PoolSolutions=n_sample, ``sat.py:167-173``) has no
+  HiGHS analog: n_sample > 1 replicates the single optimum.
+
+Unlike the reference's pure feasibility program, the objective minimises the
+scaled L1 distance to the hot start (or initial state) — "closest repair"
+— which is a strict improvement in result quality at equal validity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ...core.constraints import ConstraintSet
+from ...core.norms import is_inf, is_l2, validate_norm
+from ...models.scalers import MinMaxParams
+
+SAFETY_DELTA = 1e-7  # sat.py:18
+
+
+@dataclass
+class LinearRows:
+    """Sparse-ish linear constraint rows over the feature variables:
+    lo <= sum_j coefs[j] * x[cols[j]] <= hi, plus hard variable pins."""
+
+    rows: list  # [(cols: np.ndarray, coefs: np.ndarray, lo: float, hi: float)]
+    fixes: dict  # {var_index: value} — variables pinned to constants
+
+
+@dataclass
+class SatAttack:
+    constraints: ConstraintSet
+    sat_rows_builder: Callable[[np.ndarray, np.ndarray], LinearRows]
+    min_max_scaler: MinMaxParams
+    eps: float
+    norm: Any = np.inf
+    n_sample: int = 1
+    n_jobs: int = 1
+    time_limit: float | None = 30.0
+
+    def __post_init__(self):
+        validate_norm(self.norm)
+        schema = self.constraints.schema
+        self._int_mask = np.array([str(t) != "real" for t in schema.types])
+        self._mutable = np.asarray(schema.mutable, dtype=bool)
+        self._scale = np.asarray(self.min_max_scaler.scale)
+        self._min = np.asarray(self.min_max_scaler.min_)
+
+    # -- per-state program --------------------------------------------------
+    def _one_generate(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
+        from scipy import optimize, sparse
+
+        d = x_init.shape[0]
+        xl, xu = self.constraints.get_feature_min_max(dynamic_input=x_init)
+        xl = np.asarray(xl, dtype=float).copy()
+        xu = np.asarray(xu, dtype=float).copy()
+
+        # ε-box in scaled space (sat.py:85-97); L2 ball inscribed by a box.
+        radius = self.eps if is_inf(self.norm) else self.eps / np.sqrt(d)
+        s_init = x_init * self._scale + self._min
+        nonzero = self._scale != 0
+        lo_box = np.where(
+            nonzero, (s_init - radius + SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xl
+        )
+        hi_box = np.where(
+            nonzero, (s_init + radius - SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xu
+        )
+        xl = np.maximum(xl, lo_box)
+        xu = np.minimum(xu, hi_box)
+
+        # immutability as bound pins (sat.py:56-61)
+        xl[~self._mutable] = x_init[~self._mutable]
+        xu[~self._mutable] = x_init[~self._mutable]
+
+        spec = self.sat_rows_builder(x_init, hot)
+        # Pins must stay inside the ε-box ∩ feature bounds: a pin outside it
+        # means the mode choice is unreachable within the budget — the
+        # program is genuinely infeasible and we fall back to x_init
+        # (sat.py:184-185) rather than silently escaping the ball.
+        tol = 1e-9
+        for i, v in spec.fixes.items():
+            if v < xl[i] - tol or v > xu[i] + tol:
+                return np.tile(x_init, (self.n_sample, 1))
+            xl[i] = xu[i] = min(max(v, xl[i]), xu[i])
+
+        # objective: scaled L1 distance to hot start via split variables
+        # x = hot + p - n, p,n >= 0; minimise sum(scale * (p + n))
+        n_rows = len(spec.rows)
+        a_rows, lo_r, hi_r = [], [], []
+        for cols, coefs, lo, hi in spec.rows:
+            row = np.zeros(d)
+            row[np.asarray(cols, dtype=int)] = np.asarray(coefs, dtype=float)
+            a_rows.append(row)
+            lo_r.append(lo)
+            hi_r.append(hi)
+
+        a_main = np.array(a_rows) if n_rows else np.zeros((0, d))
+        # split-variable rows: x_i - p_i + n_i == hot_i  (mutable only)
+        mut_idx = np.flatnonzero(self._mutable)
+        m = len(mut_idx)
+        a_split = np.zeros((m, d + 2 * m))
+        a_split[np.arange(m), mut_idx] = 1.0
+        a_split[np.arange(m), d + np.arange(m)] = -1.0
+        a_split[np.arange(m), d + m + np.arange(m)] = 1.0
+
+        a_full = np.zeros((n_rows + m, d + 2 * m))
+        a_full[:n_rows, :d] = a_main
+        a_full[n_rows:] = a_split
+        lo_full = np.concatenate([lo_r, hot[mut_idx]])
+        hi_full = np.concatenate([hi_r, hot[mut_idx]])
+
+        c = np.zeros(d + 2 * m)
+        w = np.where(self._scale[mut_idx] == 0, 1.0, np.abs(self._scale[mut_idx]))
+        c[d: d + m] = w
+        c[d + m:] = w
+
+        bounds = optimize.Bounds(
+            np.concatenate([xl, np.zeros(2 * m)]),
+            np.concatenate([xu, np.full(2 * m, np.inf)]),
+        )
+        integrality = np.concatenate(
+            [self._int_mask.astype(int), np.zeros(2 * m, dtype=int)]
+        )
+        cons = optimize.LinearConstraint(sparse.csr_matrix(a_full), lo_full, hi_full)
+
+        options = {}
+        if self.time_limit is not None:
+            options["time_limit"] = self.time_limit
+        res = optimize.milp(
+            c, constraints=cons, bounds=bounds, integrality=integrality,
+            options=options,
+        )
+        if not res.success or res.x is None:
+            out = x_init  # infeasible fallback (sat.py:184-185)
+        else:
+            out = res.x[:d]
+            out = np.where(self._int_mask, np.round(out), out)
+        return np.tile(out, (self.n_sample, 1))
+
+    # -- public API ---------------------------------------------------------
+    def generate(self, x: np.ndarray, hot_start: np.ndarray | None = None) -> np.ndarray:
+        """(S, D) initial states -> (S, n_sample, D) repaired candidates."""
+        x = np.asarray(x, dtype=float)
+        hot = x if hot_start is None else np.asarray(hot_start, dtype=float)
+        if hot.shape != x.shape:
+            raise ValueError(f"hot_start shape {hot.shape} != x shape {x.shape}")
+
+        if self.n_jobs == 1:
+            outs = [self._one_generate(x[i], hot[i]) for i in range(len(x))]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = None if self.n_jobs in (-1, 0) else self.n_jobs
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                outs = list(
+                    pool.map(lambda i: self._one_generate(x[i], hot[i]), range(len(x)))
+                )
+        return np.stack(outs)

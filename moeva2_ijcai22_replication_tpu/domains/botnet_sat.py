@@ -1,0 +1,50 @@
+"""Botnet constraints as MILP rows — fully linear, no mode fixing needed.
+
+Reference semantics: ``/root/reference/src/examples/botnet/botnet_constraints_sat.py``
+(LinExpr sum-equalities, per-port orderings, bytes <= 1500·pkts). The group
+index tables come from the same ``feat_idx.pickle`` the evaluation kernel
+uses, including its 17-port ratio quirk (see ``domains/botnet.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..attacks.sat.engine import LinearRows
+from .botnet import BotnetConstraints
+
+SLACK = 1e-4
+
+
+def make_botnet_sat_builder(constraints: BotnetConstraints):
+    fi = constraints.feat_idx
+
+    static_rows = []
+
+    # g1/g2: sum(icmp+udp+tcp) == sum(bytes_in + bytes_out) per direction
+    for side in ("s", "d"):
+        flows = np.concatenate(
+            [fi[f"icmp_sum_{side}_idx"], fi[f"udp_sum_{side}_idx"], fi[f"tcp_sum_{side}_idx"]]
+        )
+        byts = np.concatenate(
+            [fi[f"bytes_in_sum_{side}_idx"], fi[f"bytes_out_sum_{side}_idx"]]
+        )
+        cols = np.concatenate([flows, byts])
+        coefs = np.concatenate([np.ones(len(flows)), -np.ones(len(byts))])
+        static_rows.append((cols, coefs, -SLACK, SLACK))
+
+    # bytes <= 1500 * pkts per port (reference's 17-port loop)
+    ratio_bytes = np.asarray(constraints._ratio_bytes)
+    ratio_pkts = np.asarray(constraints._ratio_pkts)
+    for b, p in zip(ratio_bytes, ratio_pkts):
+        static_rows.append(([int(b), int(p)], [1.0, -1500.0], -np.inf, 0.0))
+
+    # orderings lower <= upper
+    for lo_idx, up_idx in constraints._orderings:
+        for lo, up in zip(np.asarray(lo_idx), np.asarray(up_idx)):
+            static_rows.append(([int(lo), int(up)], [1.0, -1.0], -np.inf, 0.0))
+
+    def build(x_init: np.ndarray, hot: np.ndarray) -> LinearRows:
+        return LinearRows(rows=static_rows, fixes={})
+
+    return build

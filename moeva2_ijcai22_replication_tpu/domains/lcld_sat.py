@@ -1,0 +1,85 @@
+"""LCLD constraints linearised for the MILP attack.
+
+Reference semantics: ``/root/reference/src/examples/lcld/lcld_constraints_sat.py``
+(Gurobi: indicator constraints for term ∈ {36, 60}, ``addGenConstrPow`` for
+(1+r)^term, integer div/mod date decomposition, big-M pub_rec guard).
+
+HiGHS stand-in: the nonlinear participants are pinned at hot-start values
+("mode fixing"), making every remaining constraint linear:
+
+- term snaps to the nearer of {36, 60} (g4 exact); int_rate is immutable, so
+  the amortisation factor c = r(1+r)^t/((1+r)^t − 1) is a constant and g1
+  becomes |installment − c·loan_amnt| <= 0.0999 — linear.
+- the ratio denominators annual_inc, total_acc, pub_rec and both date
+  features are pinned, so g5/g6/g8/g9/g10 are linear and g7 fixes the
+  month-difference feature to a constant.
+- one-hot groups: integral 0/1 members summing to 1.
+
+The MILP still searches loan_amnt, installment, open_acc,
+pub_rec_bankruptcies, the derived ratios, and every one-hot group — the
+features the repair actually needs to move.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.schema import FeatureSchema
+from ..attacks.sat.engine import LinearRows
+from .lcld import _months
+
+SLACK = 1e-4  # inside the evaluator's 1e-3 snap tolerance
+
+
+def make_lcld_sat_builder(schema: FeatureSchema):
+    ohe_groups = [np.asarray(g) for g in schema.ohe_groups()]
+
+    def build(x_init: np.ndarray, hot: np.ndarray) -> LinearRows:
+        rows = []
+        fixes = {}
+
+        # g4: term in {36, 60} — snap to the hot start's nearer mode
+        term = 36.0 if abs(hot[1] - 36.0) <= abs(hot[1] - 60.0) else 60.0
+        fixes[1] = term
+
+        # g1: installment = loan * c(term, rate); rate immutable → c constant
+        r = x_init[2] / 1200.0
+        growth = (1.0 + r) ** term
+        c = r * growth / (growth - 1.0)
+        rows.append(([3, 0], [1.0, -c], -0.0999, 0.0999))
+
+        # g2/g3: orderings
+        rows.append(([10, 14], [1.0, -1.0], -np.inf, 0.0))
+        rows.append(([16, 11], [1.0, -1.0], -np.inf, 0.0))
+
+        # pin the nonlinear participants at hot-start values
+        fixes[6] = hot[6]  # annual_inc (g5 denominator)
+        fixes[14] = hot[14]  # total_acc (g6 denominator)
+        fixes[7] = hot[7]  # issue_d (g7 months)
+        fixes[9] = hot[9]  # earliest_cr_line (g7 months)
+        fixes[11] = hot[11]  # pub_rec (g3/g8/g10 denominator)
+
+        # g5: ratio_loan_income == loan / annual_inc
+        rows.append(([20, 0], [1.0, -1.0 / fixes[6]], -SLACK, SLACK))
+        # g6: ratio_open_total == open_acc / total_acc
+        rows.append(([21, 10], [1.0, -1.0 / fixes[14]], -SLACK, SLACK))
+        # g7: month difference fixed by the pinned dates
+        diff = float(_months(fixes[7]) - _months(fixes[9]))
+        fixes[22] = diff
+        # g8/g9: ratios over the (constant) month difference
+        rows.append(([23, 11], [1.0, -1.0 / diff], -SLACK, SLACK))
+        rows.append(([24, 16], [1.0, -1.0 / diff], -SLACK, SLACK))
+        # g10: pub_rec_bankruptcies / pub_rec, sentinel -1 on zero denominator
+        if fixes[11] == 0:
+            fixes[25] = -1.0
+            fixes[16] = 0.0  # g3 with pub_rec = 0
+        else:
+            rows.append(([25, 16], [1.0, -1.0 / fixes[11]], -SLACK, SLACK))
+
+        # one-hot validity: each group sums to exactly 1
+        for g in ohe_groups:
+            rows.append((g, np.ones(len(g)), 1.0, 1.0))
+
+        return LinearRows(rows=rows, fixes=fixes)
+
+    return build

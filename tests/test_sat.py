@@ -1,0 +1,170 @@
+"""SAT/MIP attack tests: repaired candidates must provably satisfy constraints."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.sat import SatAttack
+from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+from moeva2_ijcai22_replication_tpu.domains.botnet_sat import make_botnet_sat_builder
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.lcld_sat import make_lcld_sat_builder
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+
+@pytest.fixture(scope="module")
+def lcld_setup(lcld_paths):
+    cons = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+    x = synth_lcld(6, cons.schema, seed=21)
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    lo = np.minimum(x.min(0), xl.min(0))
+    hi = np.maximum(x.max(0), xu.max(0))
+    scaler = fit_minmax(lo, hi)
+    return cons, x, scaler
+
+
+class TestLcldSat:
+    def test_valid_input_stays_valid(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.3,
+            norm=np.inf,
+        )
+        out = atk.generate(x)
+        assert out.shape == (len(x), 1, x.shape[1])
+        cons.check_constraints_error(out.reshape(-1, x.shape[1]))
+
+    def test_repairs_perturbed_hot_start(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        rng = np.random.default_rng(0)
+        hot = x.copy()
+        # corrupt mutable derived features (the PGD-output scenario)
+        hot[:, 3] += 40.0  # installment off-formula
+        hot[:, 20] += 0.05  # ratio off
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.5,
+            norm=np.inf,
+        )
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        g = np.asarray(cons.evaluate(jnp.asarray(out)))
+        assert (g.sum(-1) == 0).all(), g.sum(-1)
+        # repaired points stay near the hot start on untouched features
+        assert np.abs(out[:, 0] - x[:, 0]).mean() < np.abs(
+            out[:, 0] - np.zeros_like(out[:, 0])
+        ).mean()
+
+    def test_immutables_fixed(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.3,
+            norm=np.inf,
+        )
+        out = atk.generate(x)[:, 0, :]
+        imm = ~np.asarray(cons.schema.mutable)
+        np.testing.assert_allclose(out[:, imm], x[:, imm], atol=1e-9)
+
+    def test_int_and_ohe_valid(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.4,
+            norm=np.inf,
+        )
+        out = atk.generate(x)[:, 0, :]
+        int_feats = [
+            i for i, t in enumerate(cons.schema.types) if str(t) != "real"
+        ]
+        np.testing.assert_allclose(out[:, int_feats], np.round(out[:, int_feats]))
+        for g in cons.schema.ohe_groups():
+            np.testing.assert_allclose(out[:, g].sum(-1), 1.0)
+
+    def test_l2_ball_inscribed(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.2,
+            norm=2,
+        )
+        out = atk.generate(x)[:, 0, :]
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        os_ = np.asarray(scaler.transform(jnp.asarray(out)))
+        assert np.linalg.norm(os_ - xs, axis=1).max() <= 0.2 + 1e-6
+
+
+class TestBotnetSat:
+    def test_real_candidates_stay_valid(self, botnet_paths, botnet_candidates):
+        cons = BotnetConstraints(
+            botnet_paths["features"], botnet_paths["constraints"]
+        )
+        x = botnet_candidates[:4].astype(float)
+        xl, xu = cons.get_feature_min_max(dynamic_input=x)
+        lo = np.minimum(x.min(0), np.asarray(xl, float).min(0))
+        hi = np.maximum(x.max(0), np.asarray(xu, float).max(0))
+        scaler = fit_minmax(lo, hi)
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_botnet_sat_builder(cons),
+            min_max_scaler=scaler,
+            eps=4.0,
+            norm=2,
+            time_limit=60.0,
+        )
+        hot = x.copy()
+        # corrupt a sum-equality participant
+        flows = cons.feat_idx["udp_sum_s_idx"]
+        hot[:, flows[0]] += 3.0
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        g = np.asarray(cons.evaluate(jnp.asarray(out)))
+        assert (g.sum(-1) == 0).all()
+
+
+class TestSatReviewRegressions:
+    def test_pin_outside_eps_box_falls_back(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        # tiny eps: the hot start's drifted term mode (60 vs 36) is
+        # unreachable -> must return x_init, never escape the ball
+        hot = x.copy()
+        hot[:, 1] = np.where(x[:, 1] == 36.0, 60.0, 36.0)  # flip the mode
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.01,
+            norm=np.inf,
+        )
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        np.testing.assert_allclose(out, x)
+
+    def test_solutions_stay_in_eps_box(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        rng = np.random.default_rng(3)
+        hot = x + rng.normal(0, 0.02, x.shape) * np.abs(x)
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.15,
+            norm=np.inf,
+        )
+        import jax.numpy as jnp
+
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        os_ = np.asarray(scaler.transform(jnp.asarray(out)))
+        assert np.abs(os_ - xs).max() <= 0.15 + 1e-6

@@ -14,11 +14,9 @@ Subpackages
 - ``core``      feature schema, jittable genetic<->ML codec, constraint engine API
 - ``domains``   use-case plugins (LCLD credit scoring, CTU-13 botnet) + registry
 - ``models``    Flax surrogate classifiers, Keras/sklearn artifact importers, training
-- ``ops``       device kernels: non-dominated sort, niching, GA operators, ref dirs
 - ``attacks``   MoEvA2 (evolutionary), PGD/AutoPGD (gradient), MIP (exact), objectives
-- ``parallel``  mesh construction, sharding helpers, multi-host init
-- ``utils``     layered config system, metrics IO, timing/profiling
-- ``experiments`` RQ1-RQ4/SM1 runners and defense pipelines
+  (device kernels — non-dominated sort, niching, GA operators, ref dirs — live
+  under ``attacks/moeva``; mesh sharding is built into the engines)
 """
 
 __version__ = "0.1.0"

@@ -7,9 +7,6 @@ R-NSGA-III survival). For populations of a few hundred, the O(n²) domination
 matrix is tiny and a *batched* matrix formulation vastly outperforms pointer
 chasing on TPU: one ``(..., n, n)`` comparison + iterative front peeling,
 vmapped over thousands of independent initial states.
-
-A C++ host-side twin for very large archives lives in ``native/`` (see
-``moeva2_ijcai22_replication_tpu.native``).
 """
 
 from __future__ import annotations

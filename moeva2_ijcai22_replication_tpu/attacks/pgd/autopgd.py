@@ -57,9 +57,6 @@ class AutoPGD(ConstrainedPGD):
         is_ckpt_d = jnp.asarray(is_ckpt)
         interval_d = jnp.asarray(interval)
 
-        def loss(x, i):
-            return self._per_sample_loss(params, x, y, i)
-
         # Iteration-independent objective for x_best/step-halving:
         # phase-switching strategies produce incommensurable per-iteration
         # losses, so best-point tracking uses static weights (the reference's
@@ -92,10 +89,26 @@ class AutoPGD(ConstrainedPGD):
             eta_prev_ckpt=eta0,
             fbest_prev_ckpt=f0,
             improved=jnp.zeros((n,), jnp.float32),
+            hist=self._hist_init(n, x_init.dtype),
         )
 
         def body(i, c):
-            grad = jax.grad(lambda xx: loss(xx, i).sum())(c["x"])
+            def loss_with_aux(xx):
+                loss_class, cons, g = self._loss_terms(
+                    params, xx, y, i, with_g=True
+                )
+                w_class, w_cons = self._loss_weights(i, loss_class.dtype)
+                per = w_class * loss_class + w_cons * (-cons)
+                return per.sum(), (per, loss_class, cons, g)
+
+            grad, (per, loss_class, cons, g) = jax.grad(
+                loss_with_aux, has_aux=True
+            )(c["x"])
+            hist = (
+                self._hist_record(c["hist"], i, per, loss_class, cons, g)
+                if self.record_loss
+                else c["hist"]
+            )
             grad = jnp.where(jnp.isnan(grad), 0.0, grad)
             grad = jnp.where(self._mutable, grad, 0.0)
             grad = condition_grad(grad, self.norm)
@@ -139,7 +152,8 @@ class AutoPGD(ConstrainedPGD):
                 eta_prev_ckpt=jnp.where(at_ckpt, eta, c["eta_prev_ckpt"]),
                 fbest_prev_ckpt=jnp.where(at_ckpt, f_best, c["fbest_prev_ckpt"]),
                 improved=jnp.where(at_ckpt, 0.0, improved),
+                hist=hist,
             )
 
         out = jax.lax.fori_loop(0, self.max_iter, body, carry0)
-        return out["x_best"]
+        return out["x_best"], out["hist"]

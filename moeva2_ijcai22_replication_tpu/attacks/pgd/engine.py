@@ -55,12 +55,18 @@ class ConstrainedPGD:
     clip: tuple = (0.0, 1.0)
     seed: int = 0
     dtype: Any = jnp.float32
+    #: "reduced" records per-iteration [loss, loss_class, cons_sum] columns,
+    #: "full" appends the per-constraint violations (parity with the
+    #: reference's TF2Classifier history, ``classifier.py:276-296``);
+    #: exposed as ``loss_history`` (N, max_iter, C) after ``generate``.
+    record_loss: str | None = None
 
     def __post_init__(self):
         self._mutable = jnp.asarray(
             np.asarray(self.constraints.get_mutable_mask(), dtype=bool)
         )
         self._jit_attack = None
+        self.loss_history: np.ndarray | None = None
 
     # -- loss ---------------------------------------------------------------
     def _loss_weights(self, i, dtype):
@@ -82,8 +88,9 @@ class ConstrainedPGD:
             return 0.0, 1.0
         return 1.0, 0.0  # flip
 
-    def _loss_terms(self, params, x, y, i):
-        """Per-sample (class, constraint) loss terms, pre-weighting."""
+    def _loss_terms(self, params, x, y, i, with_g: bool = False):
+        """Per-sample (class, constraint) loss terms, pre-weighting; with
+        ``with_g`` also the raw per-constraint violations (for history)."""
         logits = Surrogate(self.classifier.model, params).logits(x)
         y1h = jax.nn.one_hot(y, logits.shape[-1], dtype=logits.dtype)
         loss_class = -(y1h * jax.nn.log_softmax(logits)).sum(-1)  # CE
@@ -100,6 +107,8 @@ class ConstrainedPGD:
             cons = g[..., self.ctr_id]
         else:
             cons = g.sum(-1)
+        if with_g:
+            return loss_class, cons, g
         return loss_class, cons
 
     def _static_loss_weights(self):
@@ -132,13 +141,45 @@ class ConstrainedPGD:
             return self.eps * 10.0 ** (-power)
         return self.eps_step
 
-    def _one_run(self, params, x_init, y, x_start):
-        """Full iteration loop from ``x_start`` (subclasses override)."""
+    def _hist_columns(self) -> int:
+        """History column count: [loss, loss_class, cons_sum] + per-constraint
+        violations for "full" (``classifier.py:276-296``)."""
+        if not self.record_loss:
+            return 0
+        k = self.constraints.n_constraints if "full" in self.record_loss else 0
+        return 3 + k
 
-        def body(i, x):
-            grad = jax.grad(
-                lambda xx: self._per_sample_loss(params, xx, y, i).sum()
+    def _hist_init(self, n, dtype):
+        if self.record_loss:
+            return jnp.zeros((self.max_iter, n, self._hist_columns()), dtype)
+        return jnp.zeros((), dtype)
+
+    def _hist_record(self, hist, i, per, loss_class, cons, g):
+        cols = [per, loss_class, cons]
+        stacked = jnp.column_stack(
+            cols + [g] if "full" in self.record_loss else cols
+        )
+        return hist.at[i].set(stacked.astype(hist.dtype))
+
+    def _one_run(self, params, x_init, y, x_start):
+        """Full iteration loop from ``x_start``; returns ``(x_adv, hist)``
+        where hist is (max_iter, N, C) per-iteration loss components, or a
+        scalar when recording is off (subclasses override)."""
+
+        def body(i, carry):
+            x, hist = carry
+
+            def loss_with_aux(xx):
+                loss_class, cons, g = self._loss_terms(params, xx, y, i, with_g=True)
+                w_class, w_cons = self._loss_weights(i, loss_class.dtype)
+                per = w_class * loss_class + w_cons * (-cons)
+                return per.sum(), (per, loss_class, cons, g)
+
+            grad, (per, loss_class, cons, g) = jax.grad(
+                loss_with_aux, has_aux=True
             )(x)
+            if self.record_loss:
+                hist = self._hist_record(hist, i, per, loss_class, cons, g)
             grad = jnp.where(jnp.isnan(grad), 0.0, grad)
             grad = jnp.where(self._mutable, grad, 0.0)
             grad = condition_grad(grad, self.norm)
@@ -149,9 +190,14 @@ class ConstrainedPGD:
             x = jnp.clip(x, *self.clip)
             if "repair" in self.loss_evaluation:
                 x = jnp.where(self._mutable, self._repair(x).astype(x.dtype), x)
-            return x
+            return x, hist
 
-        return jax.lax.fori_loop(0, self.max_iter, body, x_start)
+        return jax.lax.fori_loop(
+            0,
+            self.max_iter,
+            body,
+            (x_start, self._hist_init(x_init.shape[0], x_init.dtype)),
+        )
 
     def _random_start(self, key, x_init):
         k_dir, k_rad = jax.random.split(key)
@@ -178,24 +224,29 @@ class ConstrainedPGD:
                 return self._one_run(params, x_init, y, x_init)
 
             def restart(r, carry):
-                best_x, best_success = carry
+                best_x, best_success, _ = carry
                 x_start = self._random_start(jax.random.fold_in(key, r), x_init)
-                x_adv = self._one_run(params, x_init, y, x_start)
+                x_adv, hist = self._one_run(params, x_init, y, x_start)
                 probs = Surrogate(self.classifier.model, params).predict_proba(x_adv)
                 success = probs.argmax(-1) != y  # untargeted flip
                 if self.targeted:
                     success = probs.argmax(-1) == y
                 take = success & ~best_success
                 best_x = jnp.where(take[:, None], x_adv, best_x)
-                return best_x, best_success | success
+                # history follows the last restart executed
+                return best_x, best_success | success, hist
 
-            best, _ = jax.lax.fori_loop(
+            best, _, hist = jax.lax.fori_loop(
                 0,
                 self.num_random_init,
                 restart,
-                (x_init, jnp.zeros(x_init.shape[0], bool)),
+                (
+                    x_init,
+                    jnp.zeros(x_init.shape[0], bool),
+                    self._hist_init(x_init.shape[0], x_init.dtype),
+                ),
             )
-            return best
+            return best, hist
 
         return attack
 
@@ -203,11 +254,18 @@ class ConstrainedPGD:
         """Attack scaled candidates ``x_scaled`` with true labels ``y``."""
         if self._jit_attack is None:
             self._jit_attack = jax.jit(self._build())
-        out = self._jit_attack(
+        out, hist = self._jit_attack(
             self.classifier.params,
             jnp.asarray(x_scaled, self.dtype),
             jnp.asarray(y, jnp.int32),
             jax.random.PRNGKey(self.seed),
+        )
+        # (N, max_iter, C) — runners add the reference's unit axis on save
+        # (01_pgd_united.py:196-199).
+        self.loss_history = (
+            np.swapaxes(np.asarray(jax.device_get(hist)), 0, 1)
+            if self.record_loss
+            else None
         )
         return np.asarray(jax.device_get(out))
 

@@ -1,0 +1,16 @@
+"""Experiment entry points (L4) and research-question orchestration (L5).
+
+Parity targets under ``/root/reference/src``:
+
+- :mod:`.moeva`   — ``experiments/united/04_moeva.py`` (MoEvA2 runner)
+- :mod:`.pgd`     — ``experiments/united/01_pgd_united.py`` (PGD/AutoPGD/SAT)
+- :mod:`.rq`      — ``run_rq1.py`` / ``run_rq2.py`` / ``run_rq3.py`` grids
+- :mod:`.run_all` — ``run_all.sh``
+
+Runners are plain functions ``run(config) -> metrics | None`` so grids
+compose in-process within one JAX runtime; each module also has a CLI
+(``python -m moeva2_ijcai22_replication_tpu.experiments.moeva -c … -p …``)
+mirroring the reference's subprocess interface.
+"""
+
+from . import common  # noqa: F401

@@ -1,0 +1,107 @@
+"""Shared runner plumbing: artifact loading, skip-if-done, SAT registry.
+
+Mirrors the setup blocks both reference entry points share
+(``04_moeva.py:41-64``, ``01_pgd_united.py:50-77``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..domains import get_constraints_class
+from ..domains.botnet_sat import make_botnet_sat_builder
+from ..domains.lcld_sat import make_lcld_sat_builder
+from ..models.scalers import MinMaxParams, load_joblib_scaler
+from ..utils import filter_initial_states
+from ..utils.config import get_dict_hash
+from ..utils.in_out import load_model
+
+
+def metrics_path_for(config: dict, mid_fix: str) -> str:
+    out_dir = config["dirs"]["results"]
+    return f"{out_dir}/metrics_{mid_fix}_{get_dict_hash(config)}.json"
+
+
+def should_skip(config: dict, mid_fix: str) -> bool:
+    """Config-hash idempotency (``04_moeva.py:31-36``): a metrics file for
+    this exact config means the experiment already ran."""
+    path = metrics_path_for(config, mid_fix)
+    if os.path.exists(path):
+        print(
+            f"Configuration with hash {get_dict_hash(config)} already "
+            "executed. Skipping"
+        )
+        return True
+    return False
+
+
+def load_constraints(config: dict):
+    """Constraint plugin from the registry, with optional explicit
+    important-features path (``04_moeva.py:43-53``)."""
+    cls = get_constraints_class(config["project_name"])
+    kwargs = {}
+    if config["paths"].get("important_features"):
+        kwargs["important_features_path"] = config["paths"]["important_features"]
+    return cls(
+        config["paths"]["features"], config["paths"]["constraints"], **kwargs
+    )
+
+
+def load_candidates(config: dict) -> np.ndarray:
+    x = np.load(config["paths"]["x_candidates"])
+    return filter_initial_states(
+        x, config["initial_state_offset"], config["n_initial_state"]
+    )
+
+
+def load_scaler(config: dict) -> MinMaxParams:
+    return load_joblib_scaler(config["paths"]["ml_scaler"])
+
+
+def load_surrogate(config: dict):
+    model = load_model(config["paths"]["model"])
+    from ..models.io import Surrogate
+
+    if not isinstance(model, Surrogate):
+        raise TypeError(
+            f"{config['paths']['model']} is not a device-runnable surrogate; "
+            "attack runners need a Keras/Flax artifact"
+        )
+    return model
+
+
+def get_sat_builder(project_name: str, constraints):
+    """Project-name -> MILP row builder (parity:
+    ``united/utils.py:28-30``'s STR_TO_SAT_CONSTRAINTS)."""
+    if project_name.startswith("lcld"):
+        return make_lcld_sat_builder(constraints.schema)
+    if project_name.startswith("botnet"):
+        return make_botnet_sat_builder(constraints)
+    raise ValueError(f"No SAT constraint builder for project {project_name!r}")
+
+
+def evaluation_constraints(config: dict, attack_constraints):
+    """RQ2's evaluation override: success is judged under a different
+    constraint set than the attack used (``04_moeva.py:116-120``)."""
+    ev = config.get("evaluation")
+    if not ev:
+        return attack_constraints
+    cls = get_constraints_class(ev["project_name"])
+    return cls(config["paths"]["features"], ev["constraints"])
+
+
+def build_mesh(config: dict):
+    """Optional states-axis mesh from config ``system.mesh_devices``:
+    -1 = all visible devices, 0/absent = single device."""
+    n = int(config.get("system", {}).get("mesh_devices", 0) or 0)
+    if n == 0:
+        return None
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n > 0:
+        devices = devices[:n]
+    return Mesh(np.array(devices), ("states",))

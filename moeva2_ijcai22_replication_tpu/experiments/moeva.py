@@ -1,0 +1,122 @@
+"""MoEvA2 experiment runner.
+
+Parity: ``/root/reference/src/experiments/united/04_moeva.py:27-147`` —
+config-hash skip, constraint check, timed attack, result artifacts
+(populations npy, optional history), augmented-feature reconstruction,
+per-ε success rates, and ``metrics_moeva_{hash}.json``. The attack itself
+runs as one jitted program over all initial states (optionally sharded over
+a device mesh via ``system.mesh_devices``) instead of a joblib process pool.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..attacks.moeva import Moeva2
+from ..attacks.objective import ObjectiveCalculator
+from ..domains import augmentation
+from ..utils.config import get_dict_hash, parse_config, save_config
+from ..utils.in_out import json_to_file, save_to_file
+from ..utils.observability import PhaseTimer, maybe_profile
+from . import common
+
+
+def run(config: dict):
+    """Execute one MoEvA2 experiment; returns the metrics dict, or None when
+    the config hash already has results (skip-if-done)."""
+    out_dir = config["dirs"]["results"]
+    config_hash = get_dict_hash(config)
+    mid_fix = f"{config['attack_name']}"
+    metrics_path = common.metrics_path_for(config, mid_fix)
+    if common.should_skip(config, mid_fix):
+        return None
+
+    os.makedirs(out_dir, exist_ok=True)
+    print(config)
+    timer = PhaseTimer()
+
+    # ----- Load and create necessary objects (04_moeva.py:41-60)
+    with timer.phase("setup"):
+        constraints = common.load_constraints(config)
+        x_initial_states = common.load_candidates(config)
+        scaler = common.load_scaler(config)
+        surrogate = common.load_surrogate(config)
+
+        # ----- Check constraints (04_moeva.py:64)
+        constraints.check_constraints_error(x_initial_states)
+
+    start_time = time.time()
+    moeva = Moeva2(
+        classifier=surrogate,
+        constraints=constraints,
+        ml_scaler=scaler,
+        norm=config["norm"],
+        n_gen=config["budget"],
+        n_pop=config["n_pop"],
+        n_offsprings=config["n_offsprings"],
+        seed=config["seed"],
+        save_history=config.get("save_history") or None,
+        mesh=common.build_mesh(config),
+    )
+    with timer.phase("attack"), maybe_profile(
+        config.get("system", {}).get("profile_dir")
+    ):
+        result = moeva.generate(x_initial_states, 1)
+    consumed_time = time.time() - start_time
+
+    # ----- Persist populations ((S, P, D) ndarray — results_to_numpy_results)
+    x_attacks = result.x_ml
+    if config.get("reconstruction"):
+        # Strip the stale augmented columns and recompute them from the
+        # attacked base features (04_moeva.py:97-104).
+        important = constraints.important_features
+        n_pairs = augmentation.n_pairs(important)
+        x_attacks = np.asarray(
+            augmentation.augment(x_attacks[..., :-n_pairs], important)
+        )
+    save_to_file(x_attacks, f"{out_dir}/x_attacks_{mid_fix}_{config_hash}.npy")
+
+    if config.get("save_history") and len(result.history) > 1:
+        # (n_gen-1, S, n_off, C) per-generation objective history
+        np.save(
+            f"{out_dir}/x_history_{mid_fix}_{config_hash}.npy",
+            np.stack(result.history[1:]),
+        )
+
+    # ----- Success rates per ε (04_moeva.py:112-131)
+    with timer.phase("evaluate"):
+        eval_constraints = common.evaluation_constraints(config, constraints)
+        objective_lists = []
+        for eps in config["eps_list"]:
+            calc = ObjectiveCalculator(
+                classifier=surrogate,
+                constraints=eval_constraints,
+                thresholds={
+                    "f1": config["misclassification_threshold"],
+                    "f2": eps,
+                },
+                min_max_scaler=scaler,
+                ml_scaler=scaler,
+                minimize_class=1,
+                norm=config["norm"],
+            )
+            df = calc.success_rate_3d_df(x_initial_states, x_attacks)
+            objective_lists.append(df.to_dict(orient="records")[0])
+
+    metrics = {
+        "objectives_list": objective_lists,
+        "time": consumed_time,
+        "timings": timer.spans,
+        "config": config,
+        "config_hash": config_hash,
+    }
+    json_to_file(metrics, metrics_path)
+    save_config(config, f"{out_dir}/config_{mid_fix}_")
+    return metrics
+
+
+if __name__ == "__main__":
+    run(parse_config())

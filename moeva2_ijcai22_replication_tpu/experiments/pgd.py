@@ -1,0 +1,160 @@
+"""PGD / AutoPGD / SAT experiment runner.
+
+Parity: ``/root/reference/src/experiments/united/01_pgd_united.py:29-222`` —
+config-hash skip, ε-halving when a SAT pass follows, PGD vs AutoPGD selection
+by ``loss_evaluation``, scaled-space attack with mutable-feature masking,
+directional integer rounding toward the original, SAT repair with the
+gradient output as hot start, reconstruction, success rates, and
+``metrics_pgd_{loss}_{hash}.json`` + success-rate CSV.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..attacks.objective import ObjectiveCalculator
+from ..attacks.pgd import AutoPGD, ConstrainedPGD, round_ints_toward_initial
+from ..attacks.sat import SatAttack
+from ..domains import augmentation
+from ..utils.config import get_dict_hash, parse_config, save_config
+from ..utils.in_out import json_to_file
+from ..utils.observability import PhaseTimer, maybe_profile
+from . import common
+
+
+def run(config: dict):
+    """Execute one gradient-attack experiment; returns the metrics dict, or
+    None when the config hash already has results."""
+    out_dir = config["dirs"]["results"]
+    config_hash = get_dict_hash(config)
+    mid_fix = f"{config['attack_name']}_{config['loss_evaluation']}"
+    metrics_path = common.metrics_path_for(config, mid_fix)
+    if common.should_skip(config, mid_fix):
+        return None
+
+    os.makedirs(out_dir, exist_ok=True)
+    print(config)
+    timer = PhaseTimer()
+    apply_sat = "sat" in config["loss_evaluation"]
+
+    with timer.phase("setup"):
+        constraints = common.load_constraints(config)
+        x_initial = common.load_candidates(config)
+        scaler = common.load_scaler(config)
+        surrogate = common.load_surrogate(config)
+        constraints.check_constraints_error(x_initial)
+
+    start_time = time.time()
+    # Use only half ε if SAT runs after (01_pgd_united.py:97).
+    per_attack_eps = config["eps"] / 2 if apply_sat else config["eps"]
+
+    cls = AutoPGD if "autopgd" in config["loss_evaluation"] else ConstrainedPGD
+    kwargs = dict(
+        classifier=surrogate,
+        constraints=constraints,
+        scaler=scaler,
+        eps=per_attack_eps - 0.000001,
+        max_iter=int(config["budget"]),
+        norm=config["norm"],
+        loss_evaluation=config["loss_evaluation"],
+        constraints_optim=config.get("constraints_optim", "sum"),
+        seed=config["seed"],
+        record_loss=config.get("save_history") or None,
+    )
+    if cls is AutoPGD:
+        # AutoPGD defaults (01_pgd_united.py:99-111)
+        kwargs.update(
+            eps_step=per_attack_eps / 3,
+            num_random_init=config.get("nb_random", 1),
+        )
+    else:
+        kwargs.update(
+            eps_step=0.1,
+            num_random_init=config.get("nb_random", 0),
+        )
+    attack = cls(**kwargs)
+
+    with timer.phase("attack"), maybe_profile(
+        config.get("system", {}).get("profile_dir")
+    ):
+        x_scaled = np.asarray(scaler.transform(x_initial))
+        # ART infers labels from the classifier's own predictions when no y
+        # is given (the reference calls generate(x) label-free).
+        y = np.asarray(surrogate.predict_proba(x_scaled)).argmax(-1)
+        x_adv_scaled = attack.generate(x_scaled, y)
+        x_attacks = np.asarray(scaler.inverse(x_adv_scaled))
+
+        # Directional integer rounding (01_pgd_united.py:130-137).
+        x_attacks = round_ints_toward_initial(
+            x_attacks, x_initial, constraints.get_feature_type()
+        )
+
+        if apply_sat:
+            sat = SatAttack(
+                constraints,
+                common.get_sat_builder(config["project_name"], constraints),
+                scaler,
+                per_attack_eps,
+                np.inf,
+                n_sample=1,
+                n_jobs=config["system"]["n_jobs"],
+            )
+            x_attacks = sat.generate(x_initial, x_attacks)[:, 0, :]
+
+    if config.get("reconstruction"):
+        important = constraints.important_features
+        n_pairs = augmentation.n_pairs(important)
+        x_attacks = np.asarray(
+            augmentation.augment(x_attacks[..., :-n_pairs], important)
+        )
+    consumed_time = time.time() - start_time
+
+    if x_attacks.ndim == 2:
+        x_attacks = x_attacks[:, np.newaxis, :]
+
+    with timer.phase("evaluate"):
+        eval_constraints = common.evaluation_constraints(config, constraints)
+        calc = ObjectiveCalculator(
+            classifier=surrogate,
+            constraints=eval_constraints,
+            thresholds={
+                "f1": config["misclassification_threshold"],
+                "f2": config["eps"],
+            },
+            min_max_scaler=scaler,
+            ml_scaler=scaler,
+            minimize_class=1,
+            norm=config["norm"],
+        )
+        success_rate_df = calc.success_rate_3d_df(x_initial, x_attacks)
+    print(success_rate_df)
+
+    np.save(f"{out_dir}/x_attacks_{mid_fix}_{config_hash}.npy", x_attacks)
+    if config.get("save_history") and attack.loss_history is not None:
+        # (N, max_iter, 1, C) loss-component curves, the reference's saved
+        # layout (01_pgd_united.py:196-199; C = 3 for "reduced", 3+K "full").
+        np.save(
+            f"{out_dir}/x_history_{config_hash}.npy",
+            attack.loss_history[:, :, np.newaxis, :],
+        )
+
+    metrics = {
+        "objectives": success_rate_df.to_dict(orient="records")[0],
+        "time": consumed_time,
+        "timings": timer.spans,
+        "config": config,
+        "config_hash": config_hash,
+    }
+    success_rate_df.to_csv(
+        f"{out_dir}/success_rate_{mid_fix}_{config_hash}.csv", index=False
+    )
+    json_to_file(metrics, metrics_path)
+    save_config(config, f"{out_dir}/config_{mid_fix}_")
+    return metrics
+
+
+if __name__ == "__main__":
+    run(parse_config())

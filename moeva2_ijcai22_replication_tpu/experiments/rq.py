@@ -1,0 +1,134 @@
+"""Research-question grid runners (L5 orchestration).
+
+Parity: ``/root/reference/src/run_rq{1,2,3}.py`` — nested loops over
+seeds × projects × budgets (× scenari for RQ2, × models for RQ3) composing
+layered configs and launching the MoEvA and PGD runners per grid point. The
+three reference scripts are the same loop with one optional axis each, so a
+single runner handles all of them: the ``scenari`` / ``models`` axes are
+driven by config presence.
+
+Launch modes: in-process (default — runner functions are called directly,
+sharing one JAX runtime across the grid) or ``use_subprocess=True`` for the
+reference's process-isolation semantics (failed points are logged and the
+grid continues).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import subprocess
+import sys
+
+from ..utils.config import load_config_file, merge_config, parse_config
+
+TABULATOR = ">>>"
+logger = logging.getLogger(__name__)
+
+
+def _compose(config_dir: str, base_name: str, project: str, overrides: list[dict]) -> dict:
+    """Layered config: {base attack yaml} <- {project static yaml} <- overrides
+    (the reference's ``-c attack.yaml -c project.yaml -p/-j …`` stack)."""
+    cfg: dict = {}
+    merge_config(cfg, load_config_file(os.path.join(config_dir, f"{base_name}.yaml")))
+    merge_config(cfg, load_config_file(os.path.join(config_dir, f"{project}.yaml")))
+    for o in overrides:
+        merge_config(cfg, copy.deepcopy(o))
+    return cfg
+
+
+class GridRunner:
+    """Expand the grid and launch one experiment per point."""
+
+    def __init__(self, config: dict, use_subprocess: bool = False):
+        self.config = config
+        self.use_subprocess = use_subprocess
+        self.launch_counter = 0
+
+    # -- launching ----------------------------------------------------------
+    def _launch(self, module: str, cfg: dict) -> None:
+        self.launch_counter += 1
+        if self.use_subprocess:
+            blob = json.dumps(cfg, separators=(",", ":"))
+            script = [sys.executable, "-m", module, "-j", blob]
+            logger.info(script)
+            proc = subprocess.run(script)
+            if proc.returncode != 0:
+                logger.error(
+                    "grid point failed (rc=%d): %s", proc.returncode, script
+                )
+            return
+        logger.info("in-process %s %s", module, cfg.get("attack_name"))
+        if module.endswith(".moeva"):
+            from . import moeva as runner
+        else:
+            from . import pgd as runner
+        runner.run(cfg)
+
+    def _launch_moeva(self, project: str, overrides: list[dict]) -> None:
+        cfg = _compose(
+            self.config["config_dir"],
+            "moeva",
+            project,
+            overrides + [{"eps_list": self.config["eps_list"]}],
+        )
+        self._launch("moeva2_ijcai22_replication_tpu.experiments.moeva", cfg)
+
+    def _launch_pgd(self, project: str, overrides: list[dict]) -> None:
+        for eps in self.config["eps_list"]:
+            logger.info(f"{TABULATOR * 5} Running eps {eps} ...")
+            for loss_evaluation in self.config["loss_evaluations"]:
+                logger.info(
+                    f"{TABULATOR * 6} Running loss_evaluation {loss_evaluation} ..."
+                )
+                cfg = _compose(
+                    self.config["config_dir"],
+                    "pgd",
+                    project,
+                    overrides + [{"eps": eps, "loss_evaluation": loss_evaluation}],
+                )
+                self._launch("moeva2_ijcai22_replication_tpu.experiments.pgd", cfg)
+
+    # -- grid ---------------------------------------------------------------
+    def _extra_axis(self) -> list[list[dict]]:
+        """RQ2's scenari (config-fragment overrides) or RQ3's models (model
+        path overrides); RQ1 has the single empty point."""
+        if "scenari" in self.config:
+            return [[scenario] for scenario in self.config["scenari"]]
+        if "models" in self.config:
+            return [
+                [{"paths": {"model": model}}] for model in self.config["models"]
+            ]
+        return [[]]
+
+    def run(self) -> int:
+        config = self.config
+        for seed in config["seeds"]:
+            logger.info(f"{TABULATOR} Running seed {seed} ...")
+            for project in config["projects"]:
+                logger.info(f"{TABULATOR * 2} Running project {project} ...")
+                for budget in config["budgets"]:
+                    logger.info(f"{TABULATOR * 3} Running budget {budget} ...")
+                    for extra in self._extra_axis():
+                        overrides = [{"seed": seed, "budget": budget}] + extra
+                        if "moeva" in config["attacks"]:
+                            logger.info(f"{TABULATOR * 4} Running MoEvA ...")
+                            self._launch_moeva(project, overrides)
+                        if "pgd" in config["attacks"]:
+                            logger.info(f"{TABULATOR * 4} Running pgd ...")
+                            self._launch_pgd(project, overrides)
+        return self.launch_counter
+
+
+def run(config: dict, use_subprocess: bool = False) -> int:
+    runner = GridRunner(config, use_subprocess=use_subprocess)
+    n = runner.run()
+    logger.info(f"{n} run executed.")
+    return n
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    run(parse_config())

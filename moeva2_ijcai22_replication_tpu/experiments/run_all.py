@@ -1,0 +1,52 @@
+"""Full experiment suite: RQ1-RQ4 + SM1 (parity: ``run_all.sh``).
+
+Every run is config-hash idempotent, so re-invoking after a crash resumes
+where it stopped — the reference's recovery model (SURVEY.md §5).
+
+Usage::
+
+    python -m moeva2_ijcai22_replication_tpu.experiments.run_all [config_dir]
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..utils.config import load_config_file
+from . import moeva, rq
+
+logger = logging.getLogger(__name__)
+
+RQ_GRIDS = [
+    "rq1.lcld.yaml",
+    "rq1.botnet.yaml",
+    "rq2.lcld.yaml",
+    "rq2.botnet.yaml",
+    "rq3.lcld.yaml",
+    "rq3.botnet.yaml",
+]
+RQ4_CONFIGS = ["rq4.lcld.moeva.yaml", "rq4.lcld.moeva_augmented.yaml"]
+SM1_GRIDS = [
+    "sm1.1.lcld.yaml",
+    "sm1.2.lcld.yaml",
+    "sm1.1.botnet.yaml",
+    "sm1.2.botnet.yaml",
+]
+
+
+def run(config_dir: str = "./config") -> None:
+    for grid in RQ_GRIDS:
+        logger.info("=== grid %s", grid)
+        rq.run(load_config_file(f"{config_dir}/{grid}"))
+    for cfg in RQ4_CONFIGS:
+        logger.info("=== rq4 %s", cfg)
+        moeva.run(load_config_file(f"{config_dir}/{cfg}"))
+    for grid in SM1_GRIDS:
+        logger.info("=== grid %s", grid)
+        rq.run(load_config_file(f"{config_dir}/{grid}"))
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    run(sys.argv[1] if len(sys.argv) > 1 else "./config")

@@ -1,0 +1,104 @@
+"""Layered experiment configuration with hash identity.
+
+Parity with the reference's config system
+(``/root/reference/src/config_parser/config_parser.py``): repeatable
+``-c`` (YAML/JSON file), ``-j`` (inline JSON), ``-p`` (dotted
+``key.sub=value`` with regex-based scalar typing), deep-merged in order with
+REPLACE semantics (later sources override; lists replace, dicts recurse);
+``get_dict_hash`` = md5 of the sorted-key JSON dump — the experiment
+identity used for output filenames and skip-if-done resumability.
+
+Differences by design: configs are plain dicts passed to in-process runner
+functions (no global argparse state), so grid runners compose and launch
+points without subprocess/reparse round-trips; the hash function is kept
+bit-identical so experiment identities survive the port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+
+_NUMBER_RE = re.compile(r"^[-+]?[0-9]*\.?[0-9]+(e[-+]?[0-9]+)?$")
+
+
+def value_parser(value: str):
+    """Scalar typing for ``-p`` values (``config_parser.py:11-16``):
+    number-shaped strings become int/float via YAML, all else stays str."""
+    if _NUMBER_RE.match(value) is None:
+        return str(value)
+    import yaml
+
+    return yaml.safe_load(f"v: {value}")["v"]
+
+
+def merge_config(a: dict, b: dict) -> dict:
+    """Deep-merge ``b`` into ``a`` in place (mergedeep REPLACE semantics:
+    dicts recurse, any other value — including lists — is replaced)."""
+    for k, v in b.items():
+        if isinstance(v, dict) and isinstance(a.get(k), dict):
+            merge_config(a[k], v)
+        else:
+            a[k] = v
+    return a
+
+
+def dotted_to_dict(key: str, value) -> dict:
+    """``a.b.c=v`` -> {"a": {"b": {"c": v}}} (``StrParser.key_value_to_dict``)."""
+    head, _, rest = key.partition(".")
+    return {head: dotted_to_dict(rest, value)} if rest else {head: value}
+
+
+def load_config_file(path: str) -> dict:
+    ext = os.path.splitext(path)[1]
+    with open(path) as f:
+        if ext in (".yaml", ".yml"):
+            import yaml
+
+            return yaml.full_load(f)
+        if ext == ".json":
+            return json.load(f)
+    raise ValueError(f"Unknown config extension {ext!r} for {path}")
+
+
+def parse_config(argv=None) -> dict:
+    """Build a config dict from ``-c``/``-j``/``-p`` CLI arguments, merged in
+    the order given per flag group (files, then inline JSON, then dotted
+    overrides — ``get_config``, ``config_parser.py:70-99``)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-c", action="append", help="config file (yaml or json)")
+    parser.add_argument("-j", action="append", help="inline json")
+    parser.add_argument("-p", action="append", help="dotted key.sub=value override")
+    args = parser.parse_args(argv)
+
+    config: dict = {}
+    for path in args.c or []:
+        merge_config(config, load_config_file(path))
+    for blob in args.j or []:
+        merge_config(config, json.loads(blob))
+    for kv in args.p or []:
+        key, _, raw = kv.partition("=")
+        merge_config(config, dotted_to_dict(key, value_parser(raw)))
+    return config
+
+
+def get_dict_hash(config: dict) -> str:
+    """md5 of the sorted-key JSON dump — bit-identical to the reference
+    (``config_parser.py:106-109``) so experiment identities match."""
+    return hashlib.md5(
+        json.dumps(config, sort_keys=True).encode("utf-8")
+    ).hexdigest()
+
+
+def save_config(config: dict, pre_path: str) -> str:
+    """Snapshot the config beside its results as ``{pre_path}{hash}.yaml``
+    (``config_parser.py:112-114``)."""
+    import yaml
+
+    path = f"{pre_path}{get_dict_hash(config)}.yaml"
+    with open(path, "w") as f:
+        yaml.dump(config, f)
+    return path

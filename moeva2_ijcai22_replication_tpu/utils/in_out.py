@@ -1,0 +1,104 @@
+"""Result/artifact IO + model-artifact dispatch.
+
+Parity: ``/root/reference/src/utils/in_out.py`` — json/npy/pickle helpers and
+``load_model``'s extension dispatch (``:111-127``). The Keras branch returns
+our device-native :class:`~moeva2_ijcai22_replication_tpu.models.io.Surrogate`
+(imported weights) rather than a TF object; ``.joblib`` sklearn artifacts get
+a host-side duck-typed wrapper with the same 1-column probability expansion
+as the reference's ``Classifier`` (``moeva2/classifier.py:27-28``).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+
+import numpy as np
+
+
+# -- pickle ------------------------------------------------------------------
+def pickle_from_file(path: str):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def pickle_to_file(obj, path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+
+
+# -- numpy -------------------------------------------------------------------
+def load_from_file(path: str) -> np.ndarray:
+    return np.load(path)
+
+
+def save_to_file(obj, path: str) -> None:
+    with open(path, "wb") as f:
+        np.save(f, obj)
+
+
+def load_from_dir(input_dir: str, handler=None) -> list:
+    out = []
+    for i, file in enumerate(sorted(glob.glob(input_dir + "/*.npy"))):
+        obj = np.load(file)
+        out.append(obj if handler is None else handler(i, obj))
+    return out
+
+
+# -- json --------------------------------------------------------------------
+def json_from_file(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def json_to_file(obj, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def json_from_dir(input_dir: str, handler=None) -> list:
+    out = []
+    for i, file in enumerate(sorted(glob.glob(input_dir + "/*.json"))):
+        with open(file) as f:
+            obj = json.load(f)
+        out.append(obj if handler is None else handler(i, obj))
+    return out
+
+
+# -- model artifacts ---------------------------------------------------------
+class HostClassifier:
+    """Duck-typed host-side classifier (sklearn etc.) with the reference
+    wrapper's probability-column expansion (``moeva2/classifier.py:4-41``).
+
+    Host-only: cannot serve the jitted attack kernels (those need a
+    :class:`Surrogate`); used for post-hoc evaluation of non-neural models.
+    """
+
+    def __init__(self, model):
+        self.model = model
+
+    def predict_proba(self, x) -> np.ndarray:
+        probs = np.asarray(self.model.predict_proba(np.asarray(x)))
+        if probs.shape[-1] == 1:
+            probs = np.concatenate([1.0 - probs, probs], axis=-1)
+        return probs
+
+
+def load_model(path: str):
+    """Extension dispatch (parity ``in_out.load_model``): ``.joblib`` ->
+    sklearn host wrapper; ``.model`` dir / ``.msgpack``/``.flax`` ->
+    device-native Surrogate."""
+    if path.endswith(".joblib"):
+        import joblib
+
+        return HostClassifier(joblib.load(path))
+    from ..models.io import load_classifier
+
+    return load_classifier(path)
+
+
+def ensure_dir(path: str) -> str:
+    os.makedirs(path, exist_ok=True)
+    return path

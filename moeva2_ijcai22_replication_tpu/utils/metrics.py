@@ -1,0 +1,44 @@
+"""Flatten ``metrics_*.json`` files into tabular records.
+
+Parity: ``/root/reference/src/utils/metrics.py`` — one record per (run, ε)
+for MoEvA (``objectives_list``), one per run for PGD (``objectives``).
+"""
+
+from __future__ import annotations
+
+
+def parse_moeva(metrics: dict) -> list[dict]:
+    config = metrics["config"]
+    return [
+        {
+            "attack_name": config["attack_name"],
+            "eps": config["eps_list"][i],
+            **metrics["objectives_list"][i],
+        }
+        for i in range(len(metrics["objectives_list"]))
+    ]
+
+
+def parse_pgd(metrics: dict) -> dict:
+    config = metrics["config"]
+    return {
+        "attack_name": config["loss_evaluation"],
+        "eps": config["eps"],
+        **metrics["objectives"],
+    }
+
+
+def parse_metrics(metrics: dict) -> list[dict]:
+    config = metrics["config"]
+    parsed = {
+        "n_state": config["n_initial_state"],
+        "config_hash": metrics["config_hash"],
+        "project_name": config["project_name"],
+        "budget": config["budget"],
+        "time": metrics["time"],
+        "model": config["paths"]["model"],
+        "reconstruction": config.get("reconstruction", None),
+    }
+    if config["attack_name"] == "moeva":
+        return [{**parsed, **rec} for rec in parse_moeva(metrics)]
+    return [{**parsed, **parse_pgd(metrics)}]

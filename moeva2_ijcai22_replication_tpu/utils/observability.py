@@ -1,0 +1,44 @@
+"""Per-phase timers and on-demand profiler traces.
+
+The reference's observability is wall-clock spans written into
+``metrics_*.json`` plus optional Comet/TensorBoard streams
+(``04_moeva.py:70,89``, ``src/utils/comet.py``, SURVEY.md §5). TPU
+equivalent: a :class:`PhaseTimer` collecting named spans that runners embed
+in the same metrics JSON (compile vs run vs eval visible separately), and a
+``jax.profiler`` trace context toggled by config — no external service.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class PhaseTimer:
+    """Named wall-clock spans; ``.spans`` is JSON-ready."""
+
+    def __init__(self):
+        self.spans: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.spans[name] = self.spans.get(name, 0.0) + time.time() - t0
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    """``jax.profiler.trace`` context when a directory is given, else no-op.
+
+    Wired to config ``system.profile_dir``; view with TensorBoard or Perfetto.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -1,0 +1,221 @@
+"""End-to-end experiment-runner tests on small synthetic LCLD artifacts.
+
+Covers the L4/L5 parity surface: MoEvA runner (``04_moeva.py``), PGD/SAT
+runner (``01_pgd_united.py``), skip-if-done idempotency, metrics JSON
+schema, and the RQ grid runner.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.objective import O_COLUMNS
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.experiments import moeva as moeva_runner
+from moeva2_ijcai22_replication_tpu.experiments import pgd as pgd_runner
+from moeva2_ijcai22_replication_tpu.experiments import rq as rq_runner
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.utils.config import get_dict_hash
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory, lcld_paths):
+    """Tiny but complete artifact family: candidates, model, scaler."""
+    tmp = tmp_path_factory.mktemp("artifacts")
+    cons = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+    x = synth_lcld(8, cons.schema, seed=3)
+    np.save(tmp / "x_candidates.npy", x)
+
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+    save_params(sur, str(tmp / "nn.msgpack"))
+
+    # Scaler fit over feature bounds ∪ data (01_train_robust.py:50-66) so
+    # attacked points stay inside the unit box.
+    from sklearn.preprocessing import MinMaxScaler
+    import joblib
+
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    scaler = MinMaxScaler().fit(np.vstack([x, xl, xu]))
+    joblib.dump(scaler, tmp / "scaler.joblib")
+    return dict(dir=tmp, paths=lcld_paths)
+
+
+def base_config(artifacts, out_dir, **over):
+    tmp = artifacts["dir"]
+    cfg = {
+        "project_name": "lcld",
+        "attack_name": "moeva",
+        "paths": {
+            "model": str(tmp / "nn.msgpack"),
+            "features": artifacts["paths"]["features"],
+            "constraints": artifacts["paths"]["constraints"],
+            "x_candidates": str(tmp / "x_candidates.npy"),
+            "ml_scaler": str(tmp / "scaler.joblib"),
+        },
+        "dirs": {"results": str(out_dir)},
+        "misclassification_threshold": 0.25,
+        "norm": 2,
+        "n_initial_state": -1,
+        "initial_state_offset": 0,
+        "system": {"n_jobs": 1, "verbose": 0},
+        "save_history": False,
+        "reconstruction": False,
+        "seed": 42,
+        "budget": 4,
+        "n_pop": 16,
+        "n_offsprings": 8,
+        "eps_list": [0.5],
+    }
+    for k, v in over.items():
+        cfg[k] = v
+    return cfg
+
+
+class TestMoevaRunner:
+    def test_end_to_end_and_skip(self, artifacts, tmp_path):
+        cfg = base_config(artifacts, tmp_path / "out")
+        metrics = moeva_runner.run(cfg)
+
+        h = get_dict_hash(cfg)
+        out = str(tmp_path / "out")
+        # metrics JSON schema parity (04_moeva.py:133-139)
+        assert set(metrics) >= {"objectives_list", "time", "config", "config_hash"}
+        assert metrics["config_hash"] == h
+        assert len(metrics["objectives_list"]) == 1
+        assert set(metrics["objectives_list"][0]) == set(O_COLUMNS)
+        for name in [
+            f"metrics_moeva_{h}.json",
+            f"x_attacks_moeva_{h}.npy",
+            f"config_moeva_{h}.yaml",
+        ]:
+            assert os.path.exists(os.path.join(out, name)), name
+
+        x_attacks = np.load(os.path.join(out, f"x_attacks_moeva_{h}.npy"))
+        assert x_attacks.shape[0] == 8 and x_attacks.ndim == 3
+
+        with open(os.path.join(out, f"metrics_moeva_{h}.json")) as f:
+            on_disk = json.load(f)
+        assert on_disk["objectives_list"] == metrics["objectives_list"]
+
+        # idempotency: same config hash -> skip (04_moeva.py:31-36)
+        assert moeva_runner.run(cfg) is None
+
+    def test_history_artifact(self, artifacts, tmp_path):
+        cfg = base_config(artifacts, tmp_path / "out", save_history="reduced")
+        metrics = moeva_runner.run(cfg)
+        h = metrics["config_hash"]
+        hist = np.load(tmp_path / "out" / f"x_history_moeva_{h}.npy")
+        # (n_gen-1, S, n_off, 3) objective history per generation
+        assert hist.shape == (3, 8, 8, 3)
+
+
+class TestPgdRunner:
+    def test_flip(self, artifacts, tmp_path):
+        cfg = base_config(
+            artifacts,
+            tmp_path / "out",
+            attack_name="pgd",
+            budget=5,
+        )
+        cfg["eps"] = 0.2
+        cfg["loss_evaluation"] = "flip"
+        metrics = pgd_runner.run(cfg)
+        h = metrics["config_hash"]
+        out = str(tmp_path / "out")
+        assert set(metrics["objectives"]) == set(O_COLUMNS)
+        for name in [
+            f"metrics_pgd_flip_{h}.json",
+            f"x_attacks_pgd_flip_{h}.npy",
+            f"success_rate_pgd_flip_{h}.csv",
+        ]:
+            assert os.path.exists(os.path.join(out, name)), name
+        x_attacks = np.load(os.path.join(out, f"x_attacks_pgd_flip_{h}.npy"))
+        assert x_attacks.shape == (8, 1, 47)
+        assert pgd_runner.run(cfg) is None
+
+    def test_flip_sat_chain(self, artifacts, tmp_path):
+        """PGD -> SAT hot-start chain with ε-halving (01_pgd_united.py:97-154):
+        the SAT stage must return constraint-satisfying candidates."""
+        cfg = base_config(
+            artifacts,
+            tmp_path / "out",
+            attack_name="pgd",
+            budget=5,
+        )
+        cfg["eps"] = 0.4
+        cfg["loss_evaluation"] = "flip+sat"
+        metrics = pgd_runner.run(cfg)
+        # o1 (constraint satisfaction) must be perfect after MILP repair
+        assert metrics["objectives"]["o1"] == pytest.approx(1.0)
+
+    def test_loss_history(self, artifacts, tmp_path):
+        cfg = base_config(
+            artifacts,
+            tmp_path / "out",
+            attack_name="pgd",
+            budget=6,
+            save_history="full",
+        )
+        cfg["eps"] = 0.2
+        cfg["loss_evaluation"] = "constraints+flip"
+        metrics = pgd_runner.run(cfg)
+        h = metrics["config_hash"]
+        hist = np.load(tmp_path / "out" / f"x_history_{h}.npy")
+        # (N, max_iter, 1, C): columns [loss, loss_class, cons_sum, g_1..g_10]
+        # for "full" on LCLD (classifier.py:276-296 layout)
+        assert hist.shape == (8, 6, 1, 13)
+        assert np.isfinite(hist).all()
+        # combined loss must equal class - constraints under constraints+flip
+        np.testing.assert_allclose(
+            hist[..., 0, 0],
+            hist[..., 0, 1] - hist[..., 0, 2],
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+class TestGridRunner:
+    def test_rq1_shaped_grid(self, artifacts, tmp_path):
+        """Compose attack+project configs per grid point, launch in-process,
+        write one metrics file per point (run_rq1.py parity)."""
+        import yaml
+
+        config_dir = tmp_path / "config"
+        config_dir.mkdir()
+        out_dir = tmp_path / "out"
+
+        point = base_config(artifacts, out_dir)
+        for key in ("attack_name", "budget", "seed", "eps_list", "n_pop", "n_offsprings"):
+            point.pop(key)
+        (config_dir / "moeva.yaml").write_text(
+            yaml.dump({"attack_name": "moeva", "n_pop": 16, "n_offsprings": 8})
+        )
+        (config_dir / "pgd.yaml").write_text(
+            yaml.dump({"attack_name": "pgd", "constraints_optim": "sum"})
+        )
+        (config_dir / "proj.static.yaml").write_text(yaml.dump(point))
+
+        grid = {
+            "config_dir": str(config_dir),
+            "attacks": ["moeva", "pgd"],
+            "seeds": [42],
+            "projects": ["proj.static"],
+            "eps_list": [0.5],
+            "budgets": [3],
+            "loss_evaluations": ["flip"],
+        }
+        n = rq_runner.run(grid)
+        assert n == 2  # one moeva + one pgd point
+        names = os.listdir(out_dir)
+        assert sum(s.startswith("metrics_moeva_") for s in names) == 1
+        assert sum(s.startswith("metrics_pgd_flip_") for s in names) == 1
+
+        # relaunching the grid skips every point but still counts launches
+        assert rq_runner.run(grid) == 2
+        assert sum(s.startswith("metrics_") for s in os.listdir(out_dir)) == 2

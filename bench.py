@@ -99,6 +99,16 @@ def measure_ref_pergen() -> float:
 def main():
     import jax
 
+    # Persistent XLA compilation cache: the jitted attack program is identical
+    # across bench invocations, so after the first run on a given backend the
+    # compile cost (~tens of seconds) is a disk load.
+    cache_dir = os.environ.get("BENCH_JAX_CACHE", "./.jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        log(f"[bench] compilation cache unavailable: {e}")
+
     log(f"[bench] devices: {jax.devices()}")
 
     from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
@@ -128,9 +138,16 @@ def main():
 
     t0 = time.time()
     res = moeva.generate(x, minimize_class=1)
-    ours_s = time.time() - t0  # includes one-time jit compile (conservative)
-    log(f"[bench] ours: {ours_s:.1f}s for {N_STATES} states x {N_GEN} gens "
-        f"(pop {moeva.pop_size})")
+    cold_s = time.time() - t0  # includes jit compile / cache load
+    t0 = time.time()
+    res = moeva.generate(x, minimize_class=1)
+    ours_s = time.time() - t0  # steady state: the production-relevant cost
+    log(f"[bench] ours: {ours_s:.1f}s steady / {cold_s:.1f}s cold "
+        f"(compile-or-cache-load {cold_s - ours_s:.1f}s) for "
+        f"{N_STATES} states x {N_GEN} gens (pop {moeva.pop_size})")
+    evals = N_STATES * (moeva.pop_size + (N_GEN - 1) * N_OFF)
+    log(f"[bench] {evals / ours_s / 1e6:.1f}M candidate evals/s "
+        "(per-stage breakdown: tools/profile_moeva.py)")
 
     # success metrics for the record (north star: parity at o-columns).
     # Scaler envelope = feature bounds ∪ data (01_train_robust.py:50-66) so
@@ -147,9 +164,9 @@ def main():
             min_max_scaler=fit_minmax(lo, hi),
             minimize_class=1, norm=2, ml_scaler=scaler,
         )
-        sub = slice(0, min(N_STATES, 200))
-        rates = calc.success_rate_3d(x[sub], res.x_ml[sub])
-        log("[bench] success rates o1..o7: " + " ".join(f"{r:.3f}" for r in rates))
+        rates = calc.success_rate_3d(x, res.x_ml)
+        log("[bench] success rates o1..o7 (all states): "
+            + " ".join(f"{r:.3f}" for r in rates))
     except Exception as e:
         log(f"[bench] success-rate eval skipped: {e}")
 

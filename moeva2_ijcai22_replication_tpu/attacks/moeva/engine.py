@@ -218,6 +218,12 @@ class Moeva2:
                 f"x has {x.shape[1]} features, schema expects {self.codec.n_features}"
             )
         s = x.shape[0]
+        if self.mesh is not None and s % self.mesh.size != 0:
+            raise ValueError(
+                f"n_states={s} must be divisible by the mesh size "
+                f"{self.mesh.size} to shard the states axis; pad the "
+                "candidate set or trim it to a multiple"
+            )
         if isinstance(minimize_class, (int, np.integer)):
             minimize_class = np.full((s,), int(minimize_class))
         minimize_class = np.asarray(minimize_class)

@@ -28,31 +28,44 @@ def domination_matrix(f: jnp.ndarray) -> jnp.ndarray:
     return le & lt
 
 
-def nd_ranks(f: jnp.ndarray) -> jnp.ndarray:
+def nd_ranks(f: jnp.ndarray, n_stop: int | None = None) -> jnp.ndarray:
     """Front index (0 = non-dominated) per candidate, shape ``f.shape[:-1]``.
 
     Iterative peeling: front r = candidates with no remaining dominator.
     The while_loop runs ``max_front_count`` times — typically ≪ n — and is
     vmap-safe (masked lockstep execution across the batch).
+
+    ``n_stop``: stop peeling once that many candidates are ranked — survival
+    only needs fronts up to the splitting front (pymoo's
+    ``fast_non_dominated_sort`` stops the same way), so ranking the dominated
+    tail is wasted sequential depth. Unpeeled candidates keep the UNRANKED
+    sentinel (they share one "worse than everything ranked" bucket, which is
+    exactly how the survival consumes them).
     """
     n = f.shape[-2]
+    if n_stop is None:
+        n_stop = n
     dom = domination_matrix(f)
 
     ranks0 = jnp.full(f.shape[:-1], UNRANKED, dtype=jnp.int32)
 
     def cond(carry):
         ranks, _ = carry
-        return (ranks == UNRANKED).any()
+        return ((ranks != UNRANKED).sum(-1) < n_stop).any() & (
+            ranks == UNRANKED
+        ).any()
 
     def body(carry):
         ranks, r = carry
         remaining = ranks == UNRANKED
+        done = (~remaining).sum(-1, keepdims=True) >= n_stop
         # dominators still unranked, per candidate j
         n_dom = (dom & remaining[..., :, None]).sum(-2)
         front = remaining & (n_dom == 0)
         # Safety: if nothing peels (cannot happen for finite f), mark all to
         # terminate rather than loop forever.
         front = jnp.where(front.any(-1, keepdims=True), front, remaining)
+        front = front & ~done  # batch rows past their quota stop updating
         return jnp.where(front, r, ranks), r + 1
 
     ranks, _ = jax.lax.while_loop(cond, body, (ranks0, jnp.int32(0)))

@@ -112,53 +112,71 @@ def _associate(f, dirs, ideal, nadir):
     return niche, dist[jnp.arange(f.shape[0]), niche]
 
 
-def _gumbel_argmax(key, logmask):
-    return jnp.argmax(logmask + jax.random.gumbel(key, logmask.shape))
-
-
 def _niching_fill(key, ranks, split_rank, niche, dist, niche_count, n_remaining, n_survive):
-    """Fill the splitting front one pick per iteration.
+    """Closed-form niching fill — water-filling instead of a pick loop.
 
-    pymoo's ``niching`` selects whole min-count cohorts per round; picking one
-    individual at a time with fresh min-count argmins is the same policy at
-    finer granularity (ties broken uniformly via Gumbel noise).
+    pymoo's ``niching`` repeatedly gives one slot to every niche at the
+    current minimum count (random subset at the final cutoff), taking the
+    closest member for an empty niche and a uniformly random member
+    otherwise. Incrementing min-count niches level by level is exactly
+    *water-filling* of ``n_remaining`` units over niches with initial counts
+    ``niche_count`` and capacities = available members, so the per-niche
+    quota has a closed form: a fixed 18-step scalar bisection finds the
+    integer water level, the cutoff level's partial cohort is a random
+    subset, and member selection is a vectorised within-niche ranking
+    (closest first for empty niches, Gumbel-random for the rest). Zero
+    data-dependent sequential steps — the survival's former ~n_survive
+    dependent kernel launches per generation collapse into a handful of
+    (M, R)/(M, M) masked matrix ops.
     """
     m = ranks.shape[0]
     r = niche_count.shape[0]
+    k_cutoff, k_member = jax.random.split(key)
     member = niche[:, None] == jnp.arange(r)[None, :]  # (M, R)
+    avail = ranks == split_rank  # (M,)
+    member_avail = member & avail[:, None]  # (M, R)
+    cap = member_avail.sum(0)  # (R,) members available per niche
+    c0 = niche_count
 
-    def body(i, carry):
-        taken, niche_count, key = carry
-        key, k_niche, k_member = jax.random.split(key, 3)
-        active = i < n_remaining
+    def filled(level):
+        return jnp.clip(level - c0, 0, cap).sum()
 
-        avail = (ranks == split_rank) & ~taken  # (M,)
-        niche_avail = (member & avail[:, None]).any(0)  # (R,)
-        counts = jnp.where(niche_avail, niche_count, jnp.inf)
-        min_count = counts.min()
-        niche_logmask = jnp.where(
-            niche_avail & (niche_count == min_count), 0.0, -jnp.inf
-        )
-        sel_niche = _gumbel_argmax(k_niche, niche_logmask)
+    # Largest integer level whose cumulative fill fits the quota.
+    def bisect(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi + 1) // 2
+        ok = filled(mid) <= n_remaining
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
 
-        members = avail & (niche == sel_niche)
-        empty_niche = niche_count[sel_niche] == 0
-        by_dist = jnp.where(members, dist, jnp.inf)
-        closest = jnp.argmin(by_dist)
-        random_pick = _gumbel_argmax(
-            k_member, jnp.where(members, 0.0, -jnp.inf)
-        )
-        pick = jnp.where(empty_niche, closest, random_pick)
+    level, _ = jax.lax.fori_loop(
+        0, 18, bisect, (jnp.int32(0), jnp.int32(m + n_survive + 1))
+    )
+    quota = jnp.clip(level - c0, 0, cap)  # (R,)
 
-        taken = taken.at[pick].set(taken[pick] | active)
-        niche_count = niche_count.at[sel_niche].add(
-            jnp.where(active, 1, 0)
-        )
-        return taken, niche_count, key
+    # Cutoff: the next unit would go to niches sitting exactly at the water
+    # level with spare members; pymoo permutes those and keeps the remainder.
+    rem = n_remaining - quota.sum()
+    elig = (quota < cap) & ((c0 + quota) == level)
+    pri = jnp.where(elig, jax.random.gumbel(k_cutoff, (r,)), -jnp.inf)
+    cut_rank = (pri[None, :] > pri[:, None]).sum(-1)
+    quota = quota + (elig & (cut_rank < rem))
 
-    taken0 = jnp.zeros((m,), bool)
-    taken, _, _ = jax.lax.fori_loop(0, n_survive, body, (taken0, niche_count, key))
-    return taken
+    # Within-niche pick order: closest member first when the niche starts
+    # empty, then uniformly random members.
+    closest = jnp.argmin(
+        jnp.where(member_avail, dist[:, None], jnp.inf), axis=0
+    )  # (R,)
+    is_closest = (
+        jnp.zeros((m,), bool).at[closest].max((c0 == 0) & (cap > 0))
+    )
+    pick_key = jnp.where(
+        is_closest & avail, -jnp.inf, jax.random.gumbel(k_member, (m,))
+    )
+    same_niche = niche[:, None] == niche[None, :]  # (M, M)
+    rank_in_niche = (
+        same_niche & avail[None, :] & (pick_key[None, :] < pick_key[:, None])
+    ).sum(-1)
+    return avail & (rank_in_niche < quota[niche])
 
 
 def survive(
@@ -176,7 +194,10 @@ def survive(
     ideal = jnp.minimum(state.ideal, f.min(0))
     worst = jnp.maximum(state.worst, f.max(0))
 
-    ranks = nd_ranks(f)
+    # Peel only until n_survive candidates are ranked: fronts beyond the
+    # splitting front never survive, and the UNRANKED sentinel on the tail is
+    # already "worse than any ranked front" for the cumulative counts below.
+    ranks = nd_ranks(f, n_stop=n_survive)
     nd_mask = ranks == 0
 
     extreme = _update_extreme_points(f, nd_mask, ideal, state.extreme)

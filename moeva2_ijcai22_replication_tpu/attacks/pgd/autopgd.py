@@ -93,17 +93,9 @@ class AutoPGD(ConstrainedPGD):
         )
 
         def body(i, c):
-            def loss_with_aux(xx):
-                loss_class, cons, g = self._loss_terms(
-                    params, xx, y, i, with_g=True
-                )
-                w_class, w_cons = self._loss_weights(i, loss_class.dtype)
-                per = w_class * loss_class + w_cons * (-cons)
-                return per.sum(), (per, loss_class, cons, g)
-
-            grad, (per, loss_class, cons, g) = jax.grad(
-                loss_with_aux, has_aux=True
-            )(c["x"])
+            grad, per, loss_class, cons, g = self._grad_and_terms(
+                params, c["x"], y, i
+            )
             hist = (
                 self._hist_record(c["hist"], i, per, loss_class, cons, g)
                 if self.record_loss

@@ -121,12 +121,22 @@ class ConstrainedPGD:
             return 0.0, 1.0
         return 1.0, 0.0
 
-    def _per_sample_loss(self, params, x, y, i):
-        """Per-sample loss the attack ASCENDS."""
-        loss_class, cons = self._loss_terms(params, x, y, i)
-        w_class, w_cons = self._loss_weights(i, loss_class.dtype)
-        # violations must shrink while CE grows, hence the minus
-        return w_class * loss_class + w_cons * (-cons)
+    def _grad_and_terms(self, params, x, y, i):
+        """Gradient of the iteration-weighted ascent loss plus its per-sample
+        components ``(grad, per, loss_class, cons, g)`` — the single shared
+        definition for both PGD and AutoPGD steps (and their history)."""
+
+        def loss_with_aux(xx):
+            loss_class, cons, g = self._loss_terms(params, xx, y, i, with_g=True)
+            w_class, w_cons = self._loss_weights(i, loss_class.dtype)
+            # violations must shrink while CE grows, hence the minus
+            per = w_class * loss_class + w_cons * (-cons)
+            return per.sum(), (per, loss_class, cons, g)
+
+        grad, (per, loss_class, cons, g) = jax.grad(
+            loss_with_aux, has_aux=True
+        )(x)
+        return grad, per, loss_class, cons, g
 
     # -- attack -------------------------------------------------------------
     def _repair(self, x):
@@ -168,16 +178,7 @@ class ConstrainedPGD:
 
         def body(i, carry):
             x, hist = carry
-
-            def loss_with_aux(xx):
-                loss_class, cons, g = self._loss_terms(params, xx, y, i, with_g=True)
-                w_class, w_cons = self._loss_weights(i, loss_class.dtype)
-                per = w_class * loss_class + w_cons * (-cons)
-                return per.sum(), (per, loss_class, cons, g)
-
-            grad, (per, loss_class, cons, g) = jax.grad(
-                loss_with_aux, has_aux=True
-            )(x)
+            grad, per, loss_class, cons, g = self._grad_and_terms(params, x, y, i)
             if self.record_loss:
                 hist = self._hist_record(hist, i, per, loss_class, cons, g)
             grad = jnp.where(jnp.isnan(grad), 0.0, grad)

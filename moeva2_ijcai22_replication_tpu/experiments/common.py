@@ -103,5 +103,10 @@ def build_mesh(config: dict):
 
     devices = jax.devices()
     if n > 0:
+        if n > len(devices):
+            raise ValueError(
+                f"system.mesh_devices={n} but only {len(devices)} devices "
+                "are visible"
+            )
         devices = devices[:n]
     return Mesh(np.array(devices), ("states",))

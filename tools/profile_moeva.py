@@ -1,0 +1,126 @@
+"""Ad-hoc perf breakdown of the MoEvA generation step (not shipped API).
+
+Times three scans over n_gen generations at bench shapes:
+  A) objective kernel only (decode+forward+constraints)
+  B) A + offspring generation (operators)
+  C) full gen_step (A + B + survival)  — the production path
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_STATES = int(os.environ.get("P_STATES", 1000))
+N_GEN = int(os.environ.get("P_GENS", 50))
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.moeva.operators import make_offspring
+from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import NormState, survive
+from moeva2_ijcai22_replication_tpu.core import codec as codec_lib
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+LCLD = "/root/reference/data/lcld"
+cons = LcldConstraints(f"{LCLD}/features.csv", f"{LCLD}/constraints.csv")
+x = synth_lcld(N_STATES, cons.schema, seed=42)
+sur = load_classifier("/root/reference/models/lcld/nn.model")
+scaler = load_joblib_scaler("/root/reference/models/lcld/scaler.joblib")
+
+moeva = Moeva2(classifier=sur, constraints=cons, ml_scaler=scaler,
+               norm=2, n_gen=N_GEN, n_pop=100, n_offsprings=100, seed=42)
+codec, tables = moeva.codec, moeva.tables
+pop_size, n_off = moeva.pop_size, moeva.n_offsprings
+
+xl_ml, xu_ml = cons.get_feature_min_max(dynamic_input=x)
+xl_ml = jnp.asarray(np.broadcast_to(np.asarray(xl_ml, float), x.shape), moeva.dtype)
+xu_ml = jnp.asarray(np.broadcast_to(np.asarray(xu_ml, float), x.shape), moeva.dtype)
+x_init = jnp.asarray(x, moeva.dtype)
+x_init_mm = codec_lib.minmax_normalize(x_init, xl_ml, xu_ml)
+mc = jnp.ones((N_STATES,), jnp.int32)
+xl_gen, xu_gen = codec_lib.genetic_bounds(codec, xl_ml, xu_ml)
+
+x0 = codec_lib.round_int_genes(codec, codec_lib.ml_to_genetic(codec, x_init))
+pop_x = jnp.broadcast_to(x0[:, None, :], (N_STATES, pop_size, codec.gen_length)).astype(moeva.dtype)
+params = sur.params
+key = jax.random.PRNGKey(0)
+asp = moeva.asp_points
+s = N_STATES
+
+
+def timed(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    out = jax.block_until_ready(fn(*args))
+    dt = time.time() - t0
+    print(f"{name}: {dt:.2f}s for {N_GEN} gens -> {dt/N_GEN*1e3:.1f} ms/gen")
+    return out
+
+
+@jax.jit
+def scan_eval(pop_x, key):
+    def step(carry, _):
+        px, k = carry
+        f, _ = moeva._evaluate(params, px, x_init, x_init_mm, xl_ml, xu_ml, mc)
+        return (px + 0.0 * f.sum() , k), ()
+    return jax.lax.scan(step, (pop_x, key), None, length=N_GEN)[0][0]
+
+
+@jax.jit
+def scan_eval_ops(pop_x, key):
+    def step(carry, _):
+        px, k = carry
+        k, k_mate = jax.random.split(k)
+        off = jax.vmap(lambda kk, xx, lo, hi: make_offspring(
+            kk, tables, xx, lo, hi, n_off))(jax.random.split(k_mate, s), px, xl_gen, xu_gen)
+        f, _ = moeva._evaluate(params, off, x_init, x_init_mm, xl_ml, xu_ml, mc)
+        px = px + 0.0 * f.sum()
+        return (px, k), ()
+    return jax.lax.scan(step, (pop_x, key), None, length=N_GEN)[0][0]
+
+
+attack = jax.jit(moeva._build_attack())
+
+
+def full(params, x_init, mc, xl, xu, key):
+    return attack(params, x_init, mc, xl, xu, key)[0]
+
+
+timed("A eval-only      ", scan_eval, pop_x, key)
+timed("B eval+operators ", scan_eval_ops, pop_x, key)
+timed("C full attack    ", full, params, x_init, mc, xl_ml, xu_ml, key)
+
+
+@jax.jit
+def scan_survive(pop_x, key):
+    merged = jnp.concatenate([pop_x, pop_x[:, :n_off] * 1.001], axis=1)
+    def step(carry, _):
+        fpop, k, st = carry
+        k, ks = jax.random.split(k)
+        mask, st, _ = jax.vmap(lambda kk, ff, s0: survive(kk, ff, asp, s0, pop_size))(
+            jax.random.split(ks, s), fpop, st)
+        return (fpop + 0.0 * mask.sum(), k, st), ()
+    f0, _ = moeva._evaluate(params, merged, x_init, x_init_mm, xl_ml, xu_ml, mc)
+    st0 = jax.vmap(lambda _: NormState.init(3, moeva.dtype))(jnp.arange(s))
+    return jax.lax.scan(step, (f0, key, st0), None, length=N_GEN)[0][0]
+
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva.nds import nd_ranks
+
+@jax.jit
+def scan_nds(pop_x, key):
+    merged = jnp.concatenate([pop_x, pop_x[:, :n_off] * 1.001], axis=1)
+    f0, _ = moeva._evaluate(params, merged, x_init, x_init_mm, xl_ml, xu_ml, mc)
+    def step(carry, _):
+        ff, k = carry
+        ranks = nd_ranks(ff)
+        return (ff + 0.0 * ranks.sum(), k), ()
+    return jax.lax.scan(step, (f0, key), None, length=N_GEN)[0][0]
+
+
+timed("D survive-only   ", scan_survive, pop_x, key)
+timed("E nds-only       ", scan_nds, pop_x, key)

@@ -6,6 +6,8 @@ Parity targets under ``/root/reference/src``:
 - :mod:`.pgd`     — ``experiments/united/01_pgd_united.py`` (PGD/AutoPGD/SAT)
 - :mod:`.rq`      — ``run_rq1.py`` / ``run_rq2.py`` / ``run_rq3.py`` grids
 - :mod:`.run_all` — ``run_all.sh``
+- :mod:`.defense` — ``experiments/{lcld,botnet}/01_train_robust.py`` pipelines
+- :mod:`.rq4`     — ``experiments/lcld/03_train_robust_rq4.py`` iteration
 
 Runners are plain functions ``run(config) -> metrics | None`` so grids
 compose in-process within one JAX runtime; each module also has a CLI

@@ -1,0 +1,468 @@
+"""Defense pipeline: augmentation + adversarial retraining artifact family.
+
+Parity: ``/root/reference/src/experiments/lcld/01_train_robust.py:31-491``
+and ``botnet/01_train_robust.py`` — the staged, artifact-memoized workflow
+that produces every model/candidate artifact the attack experiments consume:
+
+1.  min-max scaler from feature bounds ∪ data (floor/ceil envelope)
+2.  base surrogate ``nn`` + AUROC gate
+3.  top-k important mutable features (the reference uses SHAP DeepExplainer
+    on a class-balanced subsample; here: gradient×input attribution on the
+    same balanced subsample — the deep-net analog that runs as one jitted
+    program on device)
+4.  XOR-augmented dataset + ``features_augmented.csv`` /
+    ``constraints_augmented.csv`` (reference CSV schema)
+5.  augmented scaler + augmented surrogate ``nn_augmented``
+6.  adversarial candidate filter (label-1, correctly classified,
+    constraint-satisfying)
+7.  MoEvA attack on train candidates → best successful adversarial per
+    state → ``nn_moeva`` adversarial retraining
+8.  targeted PGD attack → ``nn_gradient`` (LCLD; the botnet reference
+    generates gradient adversarials but trains no gradient model)
+9.  common candidate set: test points correctly classified by every
+    defended model → ``x_candidates_common[_augmented].npy``
+
+Every stage is keyed on its output artifact (load-if-exists), so a crashed
+run resumes where it stopped — the reference's recovery model.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..attacks.moeva import Moeva2
+from ..attacks.objective import ObjectiveCalculator
+from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
+from ..domains import augmentation
+from ..models.io import Surrogate, load_classifier, save_params
+from ..models.mlp import MLP, botnet_mlp, lcld_mlp
+from ..models.scalers import from_sklearn_minmax
+from ..models.train import auroc, fit_mlp
+from ..utils.config import parse_config
+from . import common
+
+#: per-project pipeline knobs (reference: nb_important_features=5 at
+#: lcld/01_train_robust.py:36 vs 19 at botnet/01_train_robust.py:36;
+#: balanced subsample 5000/300 per class at :99 / :98; epochs/batch from the
+#: model modules; the botnet reference trains no gradient-defended model and
+#: skips the constraint filter on common candidates)
+PROJECT_DEFAULTS = {
+    "lcld": dict(
+        model_fn=lcld_mlp, nb_important=5, balanced_n=5000, epochs=100,
+        batch_size=512, augmented_suffix="", gradient_model=True,
+        common_requires_constraints=True,
+    ),
+    "botnet": dict(
+        model_fn=botnet_mlp, nb_important=19, balanced_n=300, epochs=3,
+        batch_size=256, augmented_suffix="_19", gradient_model=False,
+        common_requires_constraints=False,
+    ),
+}
+
+
+def _memo_npy(path, fn):
+    if os.path.exists(path):
+        print(f"{path} exists loading...")
+        return np.load(path)
+    out = fn()
+    np.save(path, out)
+    return out
+
+
+def _memo_model(path, fn) -> Surrogate:
+    if os.path.exists(path):
+        print(f"{path} exists loading...")
+        return load_classifier(path)
+    sur = fn()
+    save_params(sur, path)
+    return sur
+
+
+def make_trainer(model_fn, knobs: dict, seed: int):
+    """Keras-fit-parity trainer: 10% stratified val split, ES patience 25
+    (lcld/model.py:23-42) — shared by the defense and RQ4 pipelines."""
+
+    def train(x_s, y) -> Surrogate:
+        from sklearn.model_selection import train_test_split
+
+        x_tr, x_val, y_tr, y_val = train_test_split(
+            x_s, y, test_size=0.1, random_state=42, stratify=y
+        )
+        return fit_mlp(
+            model_fn(), x_tr, y_tr, x_val, y_val,
+            epochs=knobs["epochs"], batch_size=knobs["batch_size"],
+            patience=25, seed=seed,
+        ).surrogate
+
+    return train
+
+
+def proba1(sur: Surrogate, scaler, x: np.ndarray) -> np.ndarray:
+    """P(class=1) under a (sklearn-)scaled forward pass."""
+    return np.asarray(sur.predict_proba(scaler.transform(x)))[:, 1]
+
+
+def moeva_attack(model, constraints, ml_scaler, config, x_cand) -> np.ndarray:
+    """MoEvA over internally-computed candidates; pads the states axis to the
+    mesh size (candidate counts are data-dependent) and trims the result."""
+    mesh = common.build_mesh(config)
+    n = x_cand.shape[0]
+    x_run = x_cand
+    if mesh is not None and n % mesh.size != 0:
+        pad = (-n) % mesh.size
+        x_run = np.concatenate([x_cand, np.repeat(x_cand[-1:], pad, axis=0)])
+    result = Moeva2(
+        classifier=model, constraints=constraints, ml_scaler=ml_scaler,
+        norm=config["norm"], n_gen=config["budget"],
+        n_pop=config["n_pop"], n_offsprings=config["n_offsprings"],
+        seed=config["seed"], mesh=mesh,
+    ).generate(x_run, 1)
+    return result.x_ml[:n]
+
+
+def fit_envelope_scaler(schema_df, x_all: np.ndarray):
+    """sklearn MinMaxScaler over floor/ceil of feature bounds ∪ data
+    (01_train_robust.py:55-65; 'dynamic' bounds resolve to the data)."""
+    from sklearn.preprocessing import MinMaxScaler
+
+    x_min = schema_df["min"].to_numpy(dtype=object).copy()
+    x_max = schema_df["max"].to_numpy(dtype=object).copy()
+    dyn_min = x_min == "dynamic"
+    dyn_max = x_max == "dynamic"
+    x_min[dyn_min] = x_all.min(0)[dyn_min]
+    x_max[dyn_max] = x_all.max(0)[dyn_max]
+    x_min = np.minimum(x_min.astype(float), x_all.min(0))
+    x_max = np.maximum(x_max.astype(float), x_all.max(0))
+    return MinMaxScaler().fit(
+        np.stack([np.floor(x_min), np.ceil(x_max)])
+    )
+
+
+def importance_gradient_x_input(
+    surrogate: Surrogate,
+    scaler,
+    x: np.ndarray,
+    y: np.ndarray,
+    mutable_mask: np.ndarray,
+    k: int,
+    balanced_n: int,
+    seed: int = 42,
+) -> np.ndarray:
+    """Top-k important mutable features as (k, 2) [index, train-mean].
+
+    Reference: SHAP DeepExplainer values for class 0, mean |value| per
+    feature, on a RandomUnderSampler({0: n, 1: n}) subsample
+    (01_train_robust.py:98-115). Equivalent here: |gradient×(x - background
+    mean)| of the class-0 probability — DeepSHAP's single-reference linear
+    approximation — over the same balanced subsample, one jitted batch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    parts = []
+    for cls in (0, 1):
+        idx = np.flatnonzero(y == cls)
+        take = min(balanced_n, len(idx))
+        parts.append(rng.choice(idx, size=take, replace=False))
+    sub = np.concatenate(parts)
+    xs = np.asarray(scaler.transform(x[sub]))
+    background = xs.mean(0)
+
+    def p0(xrow):
+        return surrogate.predict_proba(xrow[None, :])[0, 0]
+
+    grads = jax.jit(jax.vmap(jax.grad(p0)))(jnp.asarray(xs))
+    attr = np.mean(np.abs(np.asarray(grads) * (xs - background)), axis=0)
+
+    mutable_idx = np.flatnonzero(mutable_mask)
+    order = np.argsort(attr[mutable_mask])[::-1]
+    top = mutable_idx[order][:k]
+    return np.column_stack([top, x[:, top].mean(0)])
+
+
+def augmented_schema_rows(schema_df, constraints_df, n_new: int):
+    """Append the reference's augmented-feature rows to both CSV frames
+    (01_train_robust.py:134-156)."""
+    import pandas as pd
+
+    feat_rows = pd.DataFrame(
+        [
+            {
+                "feature": f"augmented_{i}",
+                "type": "int",
+                "mutable": True,
+                "min": 0.0,
+                "max": 1.0,
+                "augmentation": True,
+            }
+            for i in range(n_new)
+        ]
+    )
+    cons_rows = pd.DataFrame(
+        [{"min": 0.0, "max": 1.0, "augmentation": True} for _ in range(n_new)]
+    )
+    if "augmentation" not in schema_df.columns:
+        schema_df = schema_df.assign(augmentation=False)
+    if "augmentation" not in constraints_df.columns:
+        constraints_df = constraints_df.assign(augmentation=False)
+    return (
+        pd.concat([schema_df, feat_rows], ignore_index=True),
+        pd.concat([constraints_df, cons_rows], ignore_index=True),
+    )
+
+
+def run(config: dict) -> dict:
+    """Execute the defense pipeline; returns the artifact-path map."""
+    import joblib
+    import pandas as pd
+
+    project = config["project_name"]
+    knobs = dict(PROJECT_DEFAULTS[project.split("_")[0]])
+    knobs.update(config.get("defense", {}))
+    threshold = config["misclassification_threshold"]
+    data_dir = config["dirs"]["data"]
+    models_dir = config["dirs"]["models"]
+    os.makedirs(data_dir, exist_ok=True)
+    os.makedirs(models_dir, exist_ok=True)
+    suffix = knobs["augmented_suffix"]
+
+    # ----- LOAD (01_train_robust.py:41-46)
+    x_train = np.load(config["paths"]["x_train"])
+    x_test = np.load(config["paths"]["x_test"])
+    y_train = np.load(config["paths"]["y_train"])
+    y_test = np.load(config["paths"]["y_test"])
+    schema_df = pd.read_csv(config["paths"]["features"])
+    constraints_df = pd.read_csv(config["paths"]["constraints"])
+    train = make_trainer(knobs["model_fn"], knobs, config["seed"])
+
+    # ----- SCALER (:50-66)
+    scaler_path = f"{models_dir}/scaler.joblib"
+    if os.path.exists(scaler_path):
+        scaler = joblib.load(scaler_path)
+    else:
+        scaler = fit_envelope_scaler(
+            schema_df, np.concatenate([x_train, x_test])
+        )
+        joblib.dump(scaler, scaler_path)
+
+    # ----- BASE MODEL + AUROC (:70-90)
+    model = _memo_model(
+        f"{models_dir}/nn.msgpack",
+        lambda: train(scaler.transform(x_train), y_train),
+    )
+    y_proba = proba1(model, scaler, x_test)
+    y_pred = (y_proba >= threshold).astype(int)
+    print(f"AUROC: {auroc(y_proba, y_test)}")
+
+    # ----- IMPORTANT FEATURES (:94-116)
+    important_features = _memo_npy(
+        f"{data_dir}/important_features{suffix}.npy",
+        lambda: importance_gradient_x_input(
+            model, scaler, x_train, y_train,
+            schema_df["mutable"].to_numpy(dtype=bool),
+            knobs["nb_important"], knobs["balanced_n"],
+        ),
+    )
+
+    # ----- AUGMENT DATASET (:120-160)
+    feats_aug_path = f"{data_dir}/features_augmented{suffix}.csv"
+    cons_aug_path = f"{data_dir}/constraints_augmented{suffix}.csv"
+    x_train_augmented = _memo_npy(
+        f"{data_dir}/x_train_augmented.npy",
+        lambda: np.asarray(augmentation.augment(x_train, important_features)),
+    )
+    x_test_augmented = _memo_npy(
+        f"{data_dir}/x_test_augmented.npy",
+        lambda: np.asarray(augmentation.augment(x_test, important_features)),
+    )
+    n_new = x_train_augmented.shape[1] - x_train.shape[1]
+    if not os.path.exists(feats_aug_path):
+        feats_aug, cons_aug = augmented_schema_rows(
+            schema_df, constraints_df, n_new
+        )
+        feats_aug.to_csv(feats_aug_path, index=False)
+        cons_aug.to_csv(cons_aug_path, index=False)
+
+    # ----- AUGMENTED SCALER (:164-179)
+    scaler_aug_path = f"{models_dir}/scaler_augmented{suffix}.joblib"
+    if os.path.exists(scaler_aug_path):
+        scaler_augmented = joblib.load(scaler_aug_path)
+    else:
+        from sklearn.preprocessing import MinMaxScaler
+
+        scaler_augmented = MinMaxScaler().fit(
+            np.stack(
+                [
+                    np.concatenate([scaler.data_min_, np.zeros(n_new)]),
+                    np.concatenate([scaler.data_max_, np.ones(n_new)]),
+                ]
+            )
+        )
+        joblib.dump(scaler_augmented, scaler_aug_path)
+
+    # ----- AUGMENTED MODEL (:183-205)
+    model_augmented = _memo_model(
+        f"{models_dir}/nn_augmented{suffix}.msgpack",
+        lambda: train(scaler_augmented.transform(x_train_augmented), y_train),
+    )
+    p_augmented = proba1(model_augmented, scaler_augmented, x_test_augmented)
+    y_pred_augmented = (p_augmented >= threshold).astype(int)
+    print(f"AUROC: {auroc(p_augmented, y_test)}")
+
+    # ----- ADVERSARIAL CANDIDATES (:208-224)
+    constraints = common.load_constraints(config)
+    correct = (
+        proba1(model, scaler, x_train) >= threshold
+    ).astype(int) == y_train
+    cand_mask = (y_train == 1) & correct
+    x_cand = x_train[cand_mask]
+    satisfied = (
+        np.asarray(constraints.evaluate(x_cand)).max(-1) <= 0
+    )
+    x_cand = x_cand[satisfied]
+    print(f"{x_cand.shape} candidates.")
+
+    ml_scaler = from_sklearn_minmax(scaler)
+    calc = ObjectiveCalculator(
+        classifier=model,
+        constraints=constraints,
+        thresholds={"f1": threshold, "f2": config["eps"]},
+        min_max_scaler=ml_scaler,
+        ml_scaler=ml_scaler,
+        minimize_class=1,
+        norm=config["norm"],
+    )
+
+    # ----- MOEVA ADVERSARIALS + RETRAINING (:230-293, :411-437)
+    x_train_moeva = _memo_npy(
+        f"{data_dir}/x_train_moeva.npy",
+        lambda: moeva_attack(model, constraints, ml_scaler, config, x_cand),
+    )
+    adv_moeva_path = f"{data_dir}/x_train_adv_moeva.npy"
+    adv_moeva_index_path = f"{data_dir}/x_train_adv_moeva_index.npy"
+    if os.path.exists(adv_moeva_path):
+        x_adv_moeva = np.load(adv_moeva_path)
+        adv_moeva_index = np.load(adv_moeva_index_path)
+    else:
+        x_adv_moeva, adv_moeva_index = calc.get_successful_attacks(
+            x_cand, x_train_moeva, preferred_metrics="misclassification",
+            order="asc", max_inputs=1, return_index_success=True,
+        )
+        print(f"Success rate: {x_adv_moeva.shape[0] / x_train_moeva.shape[0]}")
+        np.save(adv_moeva_path, x_adv_moeva)
+        np.save(adv_moeva_index_path, adv_moeva_index)
+
+    # ----- GRADIENT ADVERSARIALS (:297-397)
+    adv_grad_path = f"{data_dir}/x_train_adv_gradient.npy"
+    adv_grad_index_path = f"{data_dir}/x_train_adv_gradient_index.npy"
+    if os.path.exists(adv_grad_path):
+        x_adv_gradient = np.load(adv_grad_path)
+        adv_gradient_index = np.load(adv_grad_index_path)
+    else:
+        pgd = ConstrainedPGD(
+            classifier=model, constraints=constraints, scaler=ml_scaler,
+            eps=config["eps"] - 0.000001, eps_step=0.1,
+            max_iter=int(config["budget"]), norm=config["norm"],
+            loss_evaluation=config.get("loss_evaluation", "flip"),
+            constraints_optim=config.get("constraints_optim", "sum"),
+            # LCLD attacks toward class 0 (targeted y=[1,0] one-hots,
+            # :358-364); botnet runs the untargeted variant (:361-366).
+            targeted=knobs["gradient_model"],
+            seed=config["seed"],
+        )
+        y_att = np.zeros(x_cand.shape[0], dtype=np.int64)
+        x_att = np.asarray(
+            ml_scaler.inverse(pgd.generate(ml_scaler.transform(x_cand), y_att))
+        )
+        x_att = round_ints_toward_initial(
+            x_att, x_cand, constraints.get_feature_type()
+        )
+        x_adv_gradient, adv_gradient_index = calc.get_successful_attacks(
+            x_cand, x_att[:, None, :], preferred_metrics="misclassification",
+            order="asc", max_inputs=1, return_index_success=True,
+        )
+        print(f"Success rate: {x_adv_gradient.shape[0] / x_att.shape[0]}")
+        np.save(adv_grad_path, x_adv_gradient)
+        np.save(adv_grad_index_path, adv_gradient_index)
+
+    # ----- COMMON SUCCESS MASKS (:401-409) — LCLD only: the LCLD reference
+    # retrains each model on adversarials whose initial state BOTH attacks
+    # defeated; the botnet reference retrains on all MoEvA successes
+    # (botnet/01_train_robust.py:275).
+    if knobs["gradient_model"]:
+        both = adv_moeva_index & adv_gradient_index
+        moeva_mask = both[adv_moeva_index]
+        gradient_mask = both[adv_gradient_index]
+    else:
+        moeva_mask = np.ones(len(x_adv_moeva), dtype=bool)
+        gradient_mask = np.ones(len(x_adv_gradient), dtype=bool)
+
+    # ----- ADVERSARIAL RETRAINING (:411-466)
+    model_moeva = _memo_model(
+        f"{models_dir}/nn_moeva.msgpack",
+        lambda: train(
+            scaler.transform(
+                np.concatenate([x_train, x_adv_moeva[moeva_mask]])
+            ),
+            np.concatenate([y_train, np.ones(moeva_mask.sum(), dtype=y_train.dtype)]),
+        ),
+    )
+    p_adv_moeva = proba1(model_moeva, scaler, x_test)
+    y_pred_adv_moeva = (p_adv_moeva >= threshold).astype(int)
+    print(f"AUROC: {auroc(p_adv_moeva, y_test)}")
+
+    y_pred_adv_gradient = None
+    if knobs["gradient_model"]:
+        model_gradient = _memo_model(
+            f"{models_dir}/nn_gradient.msgpack",
+            lambda: train(
+                scaler.transform(
+                    np.concatenate([x_train, x_adv_gradient[gradient_mask]])
+                ),
+                np.concatenate(
+                    [y_train, np.ones(gradient_mask.sum(), dtype=y_train.dtype)]
+                ),
+            ),
+        )
+        p_adv_gradient = proba1(model_gradient, scaler, x_test)
+        y_pred_adv_gradient = (p_adv_gradient >= threshold).astype(int)
+        print(f"AUROC: {auroc(p_adv_gradient, y_test)}")
+
+    # ----- COMMON CANDIDATE SET (:468-491)
+    cand_path = f"{data_dir}/x_candidates_common.npy"
+    cand_aug_path = f"{data_dir}/x_candidates_common_augmented.npy"
+    if not (os.path.exists(cand_path) and os.path.exists(cand_aug_path)):
+        index = (
+            (y_test == 1)
+            & (y_test == y_pred)
+            & (y_test == y_pred_augmented)
+            & (y_test == y_pred_adv_moeva)
+        )
+        if knobs["common_requires_constraints"]:
+            index &= np.asarray(constraints.evaluate(x_test)).max(-1) <= 0
+        if y_pred_adv_gradient is not None:
+            index &= y_pred == y_pred_adv_gradient
+        np.save(cand_path, x_test[index])
+        np.save(cand_aug_path, x_test_augmented[index])
+    x_candidates = np.load(cand_path)
+    print(f"Candidates: {x_candidates.shape}.")
+
+    return {
+        "scaler": scaler_path,
+        "nn": f"{models_dir}/nn.msgpack",
+        "nn_augmented": f"{models_dir}/nn_augmented{suffix}.msgpack",
+        "nn_moeva": f"{models_dir}/nn_moeva.msgpack",
+        "nn_gradient": (
+            f"{models_dir}/nn_gradient.msgpack" if knobs["gradient_model"] else None
+        ),
+        "important_features": f"{data_dir}/important_features{suffix}.npy",
+        "x_candidates_common": cand_path,
+        "x_candidates_common_augmented": cand_aug_path,
+    }
+
+
+if __name__ == "__main__":
+    run(parse_config())

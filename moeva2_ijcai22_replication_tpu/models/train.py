@@ -21,21 +21,29 @@ from .io import Surrogate
 from .mlp import MLP
 
 
-def ce_loss(model: MLP, params, x, y, class_weight=None):
-    """Weighted softmax cross-entropy; ``y`` is integer labels."""
+def ce_loss(model: MLP, params, x, y, class_weight=None, sample_weight=None):
+    """Weighted softmax cross-entropy; ``y`` is integer labels.
+
+    ``sample_weight`` gives the weighted mean Σwℓ/Σw (Keras semantics), so
+    zero-weight padding rows contribute nothing — mesh batches pad with
+    weight 0 instead of double-counting duplicated samples.
+    """
     logits = model.apply(params, x)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
     if class_weight is not None:
         losses = losses * class_weight[y]
-    return losses.mean()
+    if sample_weight is None:
+        return losses.mean()
+    w = sample_weight.astype(losses.dtype)
+    return (losses * w).sum() / jnp.maximum(w.sum(), 1e-12)
 
 
 def make_train_step(model: MLP, tx: optax.GradientTransformation, class_weight=None):
-    """One SGD step: pure function of (params, opt_state, batch)."""
+    """One SGD step: pure function of (params, opt_state, batch, weights)."""
 
-    def step(params, opt_state, x, y):
+    def step(params, opt_state, x, y, w=None):
         loss, grads = jax.value_and_grad(
-            lambda p: ce_loss(model, p, x, y, class_weight)
+            lambda p: ce_loss(model, p, x, y, class_weight, w)
         )(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
@@ -95,7 +103,7 @@ def fit_mlp(
         opt_state = jax.device_put(opt_state, repl)
 
     rng = np.random.default_rng(seed)
-    steps_per_epoch = max(1, n // batch_size)
+    steps_per_epoch = max(1, -(-n // batch_size))  # include the partial batch
     best_val = np.inf
     best_params = params
     since_best = 0
@@ -106,17 +114,25 @@ def fit_mlp(
         epoch_loss = 0.0
         for i in range(steps_per_epoch):
             idx = perm[i * batch_size : (i + 1) * batch_size]
+            w = np.ones(len(idx), dtype=np.float32)
+            # Pad short/uneven batches with weight-0 rows so every sample
+            # contributes exactly once per epoch (batch shapes stay static
+            # for the jit cache; mesh sharding stays even).
+            target = batch_size
             if mesh is not None:
-                # pad to a multiple of the mesh size for even sharding
-                pad = (-len(idx)) % mesh.size
-                if pad:
-                    idx = np.concatenate([idx, idx[:pad]])
+                target += (-target) % mesh.size
+            pad = target - len(idx)
+            if pad:
+                idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
+                w = np.concatenate([w, np.zeros(pad, dtype=np.float32)])
             xb = jnp.asarray(x_train[idx])
             yb = jnp.asarray(y_train[idx])
+            wb = jnp.asarray(w)
             if shard is not None:
                 xb = jax.device_put(xb, shard)
                 yb = jax.device_put(yb, shard)
-            params, opt_state, loss = step(params, opt_state, xb, yb)
+                wb = jax.device_put(wb, shard)
+            params, opt_state, loss = step(params, opt_state, xb, yb, wb)
             epoch_loss += float(loss)
         epoch_loss /= steps_per_epoch
 

@@ -1,0 +1,152 @@
+"""Defense pipeline end-to-end on small synthetic LCLD data.
+
+Covers the reference's 01_train_robust workflow (scaler, base/augmented/
+adversarially-retrained models, importance selection, augmented CSV schema,
+candidate construction) plus artifact memoization.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.domains import get_constraints_class
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.experiments import defense
+
+
+@pytest.fixture(scope="module")
+def pipeline_out(tmp_path_factory, lcld_paths):
+    tmp = tmp_path_factory.mktemp("defense")
+    cons = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+    x_all = synth_lcld(384, cons.schema, seed=11)
+    # learnable synthetic target: high interest rate => charged off
+    y_all = (x_all[:, 2] > np.median(x_all[:, 2])).astype(np.int64)
+    x_train, x_test = x_all[:256], x_all[256:]
+    y_train, y_test = y_all[:256], y_all[256:]
+    for name, arr in [
+        ("x_train", x_train), ("x_test", x_test),
+        ("y_train", y_train), ("y_test", y_test),
+    ]:
+        np.save(tmp / f"{name}.npy", arr)
+
+    config = {
+        "project_name": "lcld",
+        "paths": {
+            "features": lcld_paths["features"],
+            "constraints": lcld_paths["constraints"],
+            "x_train": str(tmp / "x_train.npy"),
+            "x_test": str(tmp / "x_test.npy"),
+            "y_train": str(tmp / "y_train.npy"),
+            "y_test": str(tmp / "y_test.npy"),
+        },
+        "dirs": {"data": str(tmp / "data"), "models": str(tmp / "models")},
+        "misclassification_threshold": 0.5,
+        "norm": 2,
+        "eps": 0.5,
+        "seed": 42,
+        "budget": 3,
+        "n_pop": 8,
+        "n_offsprings": 4,
+        "system": {"n_jobs": 1, "verbose": 0},
+        "defense": {"epochs": 4, "balanced_n": 64},
+    }
+    artifacts = defense.run(config)
+    return dict(tmp=tmp, config=config, artifacts=artifacts, cons=cons,
+                x_test=x_test, y_test=y_test)
+
+
+class TestDefensePipeline:
+    def test_artifact_family(self, pipeline_out):
+        """All five reference artifact groups exist (01_train_robust.py)."""
+        a = pipeline_out["artifacts"]
+        for key in ("scaler", "nn", "nn_augmented", "nn_moeva", "nn_gradient",
+                    "important_features", "x_candidates_common",
+                    "x_candidates_common_augmented"):
+            assert a[key] and os.path.exists(a[key]), key
+
+    def test_important_features_shape(self, pipeline_out):
+        imp = np.load(pipeline_out["artifacts"]["important_features"])
+        assert imp.shape == (5, 2)
+        cons = pipeline_out["cons"]
+        mutable = np.flatnonzero(cons.get_mutable_mask())
+        assert set(imp[:, 0].astype(int)) <= set(mutable.tolist())
+
+    def test_augmented_csvs_loadable_by_domain_plugin(self, pipeline_out):
+        """The written augmented CSVs must round-trip through the augmented
+        constraint plugin (same schema the reference emits)."""
+        tmp = pipeline_out["tmp"]
+        cls = get_constraints_class("lcld_augmented")
+        aug = cls(
+            str(tmp / "data" / "features_augmented.csv"),
+            str(tmp / "data" / "constraints_augmented.csv"),
+            important_features_path=pipeline_out["artifacts"]["important_features"],
+        )
+        assert aug.schema.n_features == 47 + 10  # comb(5, 2) XOR pairs
+        x_aug = np.load(tmp / "data" / "x_test_augmented.npy")
+        assert x_aug.shape[1] == 57
+        # augmented rows are consistent by construction -> zero violations
+        aug.check_constraints_error(x_aug)
+
+    def test_common_candidates_properties(self, pipeline_out):
+        """Common candidates: label-1, constraint-satisfying, correctly
+        classified by every model (01_train_robust.py:468-491)."""
+        from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+        import joblib
+
+        a = pipeline_out["artifacts"]
+        cons = pipeline_out["cons"]
+        x_cand = np.load(a["x_candidates_common"])
+        assert x_cand.shape[0] >= 1
+        cons.check_constraints_error(x_cand)
+        scaler = joblib.load(a["scaler"])
+        for key in ("nn", "nn_augmented", "nn_moeva", "nn_gradient"):
+            if key == "nn_augmented":
+                continue  # judged in augmented space
+            sur = load_classifier(a[key])
+            proba = np.asarray(sur.predict_proba(scaler.transform(x_cand)))[:, 1]
+            assert ((proba >= 0.5) == 1).all(), f"{key} misclassifies candidates"
+
+    def test_memoization_rerun(self, pipeline_out, capsys):
+        """A second run loads every artifact instead of recomputing."""
+        artifacts = defense.run(pipeline_out["config"])
+        assert artifacts == pipeline_out["artifacts"]
+        out = capsys.readouterr().out
+        assert "exists loading..." in out
+
+
+class TestRq4Pipeline:
+    def test_iteration(self, pipeline_out):
+        """RQ4 consumes the defense artifacts and produces the 'best'
+        retrained models + rq4 candidate sets (03_train_robust_rq4.py)."""
+        from moeva2_ijcai22_replication_tpu.experiments import rq4
+
+        tmp = pipeline_out["tmp"]
+        config = dict(pipeline_out["config"])
+        config["paths"] = dict(config["paths"])
+        config["paths"]["features_augmented"] = str(
+            tmp / "data" / "features_augmented.csv"
+        )
+        config["paths"]["constraints_augmented"] = str(
+            tmp / "data" / "constraints_augmented.csv"
+        )
+        artifacts = rq4.run(config)
+        for key, path in artifacts.items():
+            assert os.path.exists(path), key
+        x_rq4 = np.load(artifacts["x_candidates_rq4_best"])
+        x_rq4_aug = np.load(artifacts["x_candidates_rq4_augmented_best"])
+        assert x_rq4.shape[1] == 47 and x_rq4_aug.shape[1] == 57
+        assert x_rq4.shape[0] == x_rq4_aug.shape[0]
+        # rq4 candidates are a subset of the common candidate set
+        x_common = np.load(pipeline_out["artifacts"]["x_candidates_common"])
+        common_rows = {tuple(r) for r in np.round(x_common, 6)}
+        assert all(tuple(r) in common_rows for r in np.round(x_rq4, 6))
+
+    def test_requires_defense_artifacts(self, pipeline_out, tmp_path):
+        from moeva2_ijcai22_replication_tpu.experiments import rq4
+
+        config = dict(pipeline_out["config"])
+        config["dirs"] = {"data": str(tmp_path), "models": str(tmp_path)}
+        with pytest.raises(FileNotFoundError):
+            rq4.run(config)

@@ -12,13 +12,22 @@ Solver: scipy's HiGHS ``milp`` (no Gurobi license assumption). Documented
 fidelity limits vs the reference:
 
 - HiGHS is linear-only, so each domain supplies *linearised* constraint rows
-  (see ``domains/*_sat.py``); nonlinear terms are pinned at hot-start values
-  ("mode fixing" — the botnet domain is fully linear and needs none).
+  (see ``domains/*_sat.py``). Discrete nonlinearities are searched as MILP
+  *modes*: a builder may append auxiliary binary variables (``n_extra_bin``)
+  and big-M rows — LCLD's term ∈ {36, 60} amortisation switch is a genuine
+  mode search, matching the reference's indicator+pow constraints
+  (``lcld_constraints_sat.py:25-36``). Continuous nonlinear participants
+  (ratio denominators, dates) remain pinned at hot-start values, with every
+  zero/degenerate pin detected and mapped to the infeasible fallback.
 - The L2 ε-ball (Gurobi pow-constraint, ``sat.py:98-124``) is inscribed by
   the per-feature box of scaled radius ε/√D — solutions remain valid L2
   members, the search space is just smaller.
-- Gurobi's solution pool (PoolSolutions=n_sample, ``sat.py:167-173``) has no
-  HiGHS analog: n_sample > 1 replicates the single optimum.
+- Gurobi's solution pool (PoolSolutions=n_sample, ``sat.py:167-173``) is
+  emulated with no-good cuts over the program's binary variables (one-hot
+  members, mode binaries): each re-solve excludes all previous binary
+  assignments, so ``n_sample > 1`` returns *distinct* candidates, ordered by
+  distance. When the binary space is exhausted the pool is padded with the
+  last solution (the reference pads with ``x_init`` when Gurobi finds none).
 
 Unlike the reference's pure feasibility program, the objective minimises the
 scaled L1 distance to the hot start (or initial state) — "closest repair"
@@ -42,10 +51,19 @@ SAFETY_DELTA = 1e-7  # sat.py:18
 @dataclass
 class LinearRows:
     """Sparse-ish linear constraint rows over the feature variables:
-    lo <= sum_j coefs[j] * x[cols[j]] <= hi, plus hard variable pins."""
+    lo <= sum_j coefs[j] * x[cols[j]] <= hi, plus hard variable pins.
+
+    ``n_extra_bin`` auxiliary {0,1} variables are appended after the
+    ``n_features`` feature variables; rows may reference them by index
+    ``n_features + k`` (mode switches for big-M constructions).
+    ``feasible=False`` short-circuits the solve: the builder proved the
+    program unsatisfiable (e.g. a zero pinned denominator), so the engine
+    takes the reference's infeasible fallback (``sat.py:184-185``)."""
 
     rows: list  # [(cols: np.ndarray, coefs: np.ndarray, lo: float, hi: float)]
     fixes: dict  # {var_index: value} — variables pinned to constants
+    n_extra_bin: int = 0
+    feasible: bool = True
 
 
 @dataclass
@@ -94,6 +112,8 @@ class SatAttack:
         xu[~self._mutable] = x_init[~self._mutable]
 
         spec = self.sat_rows_builder(x_init, hot)
+        if not spec.feasible:
+            return np.tile(x_init, (self.n_sample, 1))
         # Pins must stay inside the ε-box ∩ feature bounds: a pin outside it
         # means the mode choice is unreachable within the budget — the
         # program is genuinely infeasible and we fall back to x_init
@@ -104,59 +124,95 @@ class SatAttack:
                 return np.tile(x_init, (self.n_sample, 1))
             xl[i] = xu[i] = min(max(v, xl[i]), xu[i])
 
-        # objective: scaled L1 distance to hot start via split variables
-        # x = hot + p - n, p,n >= 0; minimise sum(scale * (p + n))
+        # variable layout: [x (d features), z (e mode binaries), p, n (split)]
+        e = spec.n_extra_bin
         n_rows = len(spec.rows)
         a_rows, lo_r, hi_r = [], [], []
         for cols, coefs, lo, hi in spec.rows:
-            row = np.zeros(d)
+            row = np.zeros(d + e)
             row[np.asarray(cols, dtype=int)] = np.asarray(coefs, dtype=float)
             a_rows.append(row)
             lo_r.append(lo)
             hi_r.append(hi)
 
-        a_main = np.array(a_rows) if n_rows else np.zeros((0, d))
-        # split-variable rows: x_i - p_i + n_i == hot_i  (mutable only)
+        a_main = np.array(a_rows) if n_rows else np.zeros((0, d + e))
+        # objective: scaled L1 distance to hot start via split variables
+        # x = hot + p - n, p,n >= 0; minimise sum(scale * (p + n))
         mut_idx = np.flatnonzero(self._mutable)
         m = len(mut_idx)
-        a_split = np.zeros((m, d + 2 * m))
+        n_var = d + e + 2 * m
+        a_split = np.zeros((m, n_var))
         a_split[np.arange(m), mut_idx] = 1.0
-        a_split[np.arange(m), d + np.arange(m)] = -1.0
-        a_split[np.arange(m), d + m + np.arange(m)] = 1.0
+        a_split[np.arange(m), d + e + np.arange(m)] = -1.0
+        a_split[np.arange(m), d + e + m + np.arange(m)] = 1.0
 
-        a_full = np.zeros((n_rows + m, d + 2 * m))
-        a_full[:n_rows, :d] = a_main
+        a_full = np.zeros((n_rows + m, n_var))
+        a_full[:n_rows, : d + e] = a_main
         a_full[n_rows:] = a_split
         lo_full = np.concatenate([lo_r, hot[mut_idx]])
         hi_full = np.concatenate([hi_r, hot[mut_idx]])
 
-        c = np.zeros(d + 2 * m)
+        c = np.zeros(n_var)
         w = np.where(self._scale[mut_idx] == 0, 1.0, np.abs(self._scale[mut_idx]))
-        c[d: d + m] = w
-        c[d + m:] = w
+        c[d + e: d + e + m] = w
+        c[d + e + m:] = w
 
-        bounds = optimize.Bounds(
-            np.concatenate([xl, np.zeros(2 * m)]),
-            np.concatenate([xu, np.full(2 * m, np.inf)]),
-        )
+        xl_full = np.concatenate([xl, np.zeros(e), np.zeros(2 * m)])
+        xu_full = np.concatenate([xu, np.ones(e), np.full(2 * m, np.inf)])
         integrality = np.concatenate(
-            [self._int_mask.astype(int), np.zeros(2 * m, dtype=int)]
+            [
+                self._int_mask.astype(int),
+                np.ones(e, dtype=int),
+                np.zeros(2 * m, dtype=int),
+            ]
         )
-        cons = optimize.LinearConstraint(sparse.csr_matrix(a_full), lo_full, hi_full)
+
+        # Binary variables carry the solution pool's no-good cuts: mode
+        # binaries plus any integer feature whose *feasible integer values*
+        # are exactly {0, 1} (one-hot members, flags) — judged on the
+        # ε-intersected box, not the schema bounds.
+        lo_int = np.ceil(xl_full[: d + e] - 1e-9)
+        hi_int = np.floor(xu_full[: d + e] + 1e-9)
+        is_bin = (integrality[: d + e] == 1) & (lo_int == 0.0) & (hi_int == 1.0)
+        bin_idx = np.flatnonzero(is_bin)
 
         options = {}
         if self.time_limit is not None:
             options["time_limit"] = self.time_limit
-        res = optimize.milp(
-            c, constraints=cons, bounds=bounds, integrality=integrality,
-            options=options,
-        )
-        if not res.success or res.x is None:
-            out = x_init  # infeasible fallback (sat.py:184-185)
-        else:
+
+        sols: list[np.ndarray] = []
+        for _ in range(self.n_sample):
+            cons = optimize.LinearConstraint(
+                sparse.csr_matrix(a_full), lo_full, hi_full
+            )
+            res = optimize.milp(
+                c,
+                constraints=cons,
+                bounds=optimize.Bounds(xl_full, xu_full),
+                integrality=integrality,
+                options=options,
+            )
+            if not res.success or res.x is None:
+                break
             out = res.x[:d]
             out = np.where(self._int_mask, np.round(out), out)
-        return np.tile(out, (self.n_sample, 1))
+            sols.append(out)
+            if len(sols) == self.n_sample or len(bin_idx) == 0:
+                break
+            # no-good cut: at least one binary flips vs this assignment —
+            # sum_{b=0} x_b + sum_{b=1} (1 - x_b) >= 1
+            assign = np.round(res.x[: d + e][bin_idx])
+            row = np.zeros(n_var)
+            row[bin_idx] = np.where(assign > 0.5, -1.0, 1.0)
+            a_full = np.vstack([a_full, row[None, :]])
+            lo_full = np.concatenate([lo_full, [1.0 - assign.sum()]])
+            hi_full = np.concatenate([hi_full, [np.inf]])
+
+        if not sols:
+            return np.tile(x_init, (self.n_sample, 1))  # sat.py:184-185
+        while len(sols) < self.n_sample:
+            sols.append(sols[-1])  # binary space exhausted: pad
+        return np.stack(sols)
 
     # -- public API ---------------------------------------------------------
     def generate(self, x: np.ndarray, hot_start: np.ndarray | None = None) -> np.ndarray:

@@ -4,20 +4,24 @@ Reference semantics: ``/root/reference/src/examples/lcld/lcld_constraints_sat.py
 (Gurobi: indicator constraints for term ∈ {36, 60}, ``addGenConstrPow`` for
 (1+r)^term, integer div/mod date decomposition, big-M pub_rec guard).
 
-HiGHS stand-in: the nonlinear participants are pinned at hot-start values
-("mode fixing"), making every remaining constraint linear:
+HiGHS formulation:
 
-- term snaps to the nearer of {36, 60} (g4 exact); int_rate is immutable, so
-  the amortisation factor c = r(1+r)^t/((1+r)^t − 1) is a constant and g1
-  becomes |installment − c·loan_amnt| <= 0.0999 — linear.
+- **term is searched, not pinned** (parity with the reference's indicator
+  constraints, ``lcld_constraints_sat.py:25-36``): an auxiliary binary z
+  selects the mode via ``term = 36 + 24·z``, and big-M rows activate the
+  matching amortisation equality |installment − c_t·loan_amnt| ≤ 0.0999
+  (int_rate is immutable, so both c_36 and c_60 are constants — the
+  (1+r)^term power never has to live inside the MILP).
 - the ratio denominators annual_inc, total_acc, pub_rec and both date
-  features are pinned, so g5/g6/g8/g9/g10 are linear and g7 fixes the
-  month-difference feature to a constant.
+  features are pinned at hot-start values, so g5/g6/g8/g9/g10 are linear and
+  g7 fixes the month-difference feature to a constant. Every pin that lands
+  on a zero denominator (annual_inc, total_acc, or a zero month difference)
+  makes the corresponding equality unsatisfiable — the builder flags the
+  program infeasible instead of emitting inf coefficients.
 - one-hot groups: integral 0/1 members summing to 1.
 
-The MILP still searches loan_amnt, installment, open_acc,
-pub_rec_bankruptcies, the derived ratios, and every one-hot group — the
-features the repair actually needs to move.
+The MILP searches term, loan_amnt, installment, open_acc,
+pub_rec_bankruptcies, the derived ratios, and every one-hot group.
 """
 
 from __future__ import annotations
@@ -31,22 +35,43 @@ from .lcld import _months
 SLACK = 1e-4  # inside the evaluator's 1e-3 snap tolerance
 
 
+def _amortisation_factor(rate_pct: float, term: float) -> float:
+    """c such that installment = c · loan_amnt (r = rate/1200); r → 0 limits
+    to the interest-free 1/term."""
+    r = rate_pct / 1200.0
+    if r <= 0.0:
+        return 1.0 / term
+    growth = (1.0 + r) ** term
+    return r * growth / (growth - 1.0)
+
+
 def make_lcld_sat_builder(schema: FeatureSchema):
     ohe_groups = [np.asarray(g) for g in schema.ohe_groups()]
+    d = schema.n_features
 
     def build(x_init: np.ndarray, hot: np.ndarray) -> LinearRows:
         rows = []
         fixes = {}
 
-        # g4: term in {36, 60} — snap to the hot start's nearer mode
-        term = 36.0 if abs(hot[1] - 36.0) <= abs(hot[1] - 60.0) else 60.0
-        fixes[1] = term
-
-        # g1: installment = loan * c(term, rate); rate immutable → c constant
-        r = x_init[2] / 1200.0
-        growth = (1.0 + r) ** term
-        c = r * growth / (growth - 1.0)
-        rows.append(([3, 0], [1.0, -c], -0.0999, 0.0999))
+        # g1 + g4: term mode search. z = extra binary at index d;
+        # term = 36 + 24·z keeps g4 exact for both assignments.
+        z = d
+        rows.append(([1, z], [1.0, -24.0], 36.0, 36.0))
+        c36 = _amortisation_factor(x_init[2], 36.0)
+        c60 = _amortisation_factor(x_init[2], 60.0)
+        xl_s, xu_s = schema.bounds(dynamic_input=x_init[None, :])
+        xl_s, xu_s = np.asarray(xl_s).reshape(-1), np.asarray(xu_s).reshape(-1)
+        big_m = (
+            max(abs(xu_s[3]), abs(xl_s[3]))
+            + max(c36, c60) * max(abs(xu_s[0]), abs(xl_s[0]))
+            + 1.0
+        )
+        # mode 36 (z = 0): |installment − c36·loan| ≤ 0.0999 + M·z
+        rows.append(([3, 0, z], [1.0, -c36, -big_m], -np.inf, 0.0999))
+        rows.append(([3, 0, z], [1.0, -c36, big_m], -0.0999, np.inf))
+        # mode 60 (z = 1): |installment − c60·loan| ≤ 0.0999 + M·(1 − z)
+        rows.append(([3, 0, z], [1.0, -c60, big_m], -np.inf, 0.0999 + big_m))
+        rows.append(([3, 0, z], [1.0, -c60, -big_m], -0.0999 - big_m, np.inf))
 
         # g2/g3: orderings
         rows.append(([10, 14], [1.0, -1.0], -np.inf, 0.0))
@@ -58,13 +83,17 @@ def make_lcld_sat_builder(schema: FeatureSchema):
         fixes[7] = hot[7]  # issue_d (g7 months)
         fixes[9] = hot[9]  # earliest_cr_line (g7 months)
         fixes[11] = hot[11]  # pub_rec (g3/g8/g10 denominator)
+        diff = float(_months(fixes[7]) - _months(fixes[9]))
+        # zero pinned denominators make g5/g6/g8/g9 unsatisfiable — flag
+        # infeasible rather than emitting inf coefficients
+        if fixes[6] == 0 or fixes[14] == 0 or diff == 0:
+            return LinearRows(rows=[], fixes={}, feasible=False)
 
         # g5: ratio_loan_income == loan / annual_inc
         rows.append(([20, 0], [1.0, -1.0 / fixes[6]], -SLACK, SLACK))
         # g6: ratio_open_total == open_acc / total_acc
         rows.append(([21, 10], [1.0, -1.0 / fixes[14]], -SLACK, SLACK))
         # g7: month difference fixed by the pinned dates
-        diff = float(_months(fixes[7]) - _months(fixes[9]))
         fixes[22] = diff
         # g8/g9: ratios over the (constant) month difference
         rows.append(([23, 11], [1.0, -1.0 / diff], -SLACK, SLACK))
@@ -80,6 +109,6 @@ def make_lcld_sat_builder(schema: FeatureSchema):
         for g in ohe_groups:
             rows.append((g, np.ones(len(g)), 1.0, 1.0))
 
-        return LinearRows(rows=rows, fixes=fixes)
+        return LinearRows(rows=rows, fixes=fixes, n_extra_bin=1)
 
     return build

@@ -135,10 +135,11 @@ class TestBotnetSat:
 
 
 class TestSatReviewRegressions:
-    def test_pin_outside_eps_box_falls_back(self, lcld_setup):
+    def test_unreachable_mode_stays_in_ball(self, lcld_setup):
         cons, x, scaler = lcld_setup
-        # tiny eps: the hot start's drifted term mode (60 vs 36) is
-        # unreachable -> must return x_init, never escape the ball
+        # tiny eps: the hot start's drifted term mode (60 vs 36) is outside
+        # the ball, so the mode search must settle on the reachable mode —
+        # solutions stay valid and never escape the ball
         hot = x.copy()
         hot[:, 1] = np.where(x[:, 1] == 36.0, 60.0, 36.0)  # flip the mode
         atk = SatAttack(
@@ -149,7 +150,10 @@ class TestSatReviewRegressions:
             norm=np.inf,
         )
         out = atk.generate(x, hot_start=hot)[:, 0, :]
-        np.testing.assert_allclose(out, x)
+        np.testing.assert_allclose(out[:, 1], x[:, 1])  # original mode kept
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        os_ = np.asarray(scaler.transform(jnp.asarray(out)))
+        assert np.abs(os_ - xs).max() <= 0.01 + 1e-6
 
     def test_solutions_stay_in_eps_box(self, lcld_setup):
         cons, x, scaler = lcld_setup
@@ -168,3 +172,64 @@ class TestSatReviewRegressions:
         xs = np.asarray(scaler.transform(jnp.asarray(x)))
         os_ = np.asarray(scaler.transform(jnp.asarray(out)))
         assert np.abs(os_ - xs).max() <= 0.15 + 1e-6
+
+
+class TestLcldModeSearchAndPool:
+    def _attack(self, cons, scaler, **kw):
+        # eps > 1 scaled: the SAFETY_DELTA-shrunk box must still contain the
+        # far term mode / raised one-hot flags
+        kw.setdefault("eps", 2.0)
+        kw.setdefault("norm", np.inf)
+        return SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            **kw,
+        )
+
+    def test_term_mode_flips_to_hot_start(self, lcld_setup):
+        """Standalone SAT must *search* term (lcld_constraints_sat.py:25-36):
+        with the whole box reachable and a hot start amortised at the other
+        mode, the MILP flips term rather than snapping back."""
+        cons, x, scaler = lcld_setup
+        from moeva2_ijcai22_replication_tpu.domains.lcld_sat import (
+            _amortisation_factor,
+        )
+
+        sel = x[:, 1] == 36.0
+        assert sel.any(), "fixture needs at least one term=36 state"
+        x36 = x[sel]
+        hot = x36.copy()
+        hot[:, 1] = 60.0
+        hot[:, 3] = [
+            _amortisation_factor(r, 60.0) * loan
+            for r, loan in zip(x36[:, 2], x36[:, 0])
+        ]
+        out = self._attack(cons, scaler).generate(x36, hot_start=hot)[:, 0, :]
+        assert (out[:, 1] == 60.0).all(), out[:, 1]
+        g = np.asarray(cons.evaluate(jnp.asarray(out)))
+        assert (g.sum(-1) == 0).all()
+
+    def test_solution_pool_returns_distinct_candidates(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        out = self._attack(cons, scaler, n_sample=3).generate(x[:3])
+        assert out.shape == (3, 3, x.shape[1])
+        for s in range(3):
+            uniq = np.unique(out[s], axis=0)
+            assert len(uniq) == 3, f"state {s}: pool not distinct"
+        # every pool member is constraint-valid
+        cons.check_constraints_error(out.reshape(-1, x.shape[1]))
+
+    def test_zero_total_acc_pin_falls_back(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        hot = x.copy()
+        hot[:, 14] = 0.0  # g6 denominator — must not become an inf coefficient
+        out = self._attack(cons, scaler).generate(x, hot_start=hot)[:, 0, :]
+        np.testing.assert_allclose(out, x)
+
+    def test_zero_month_diff_pin_falls_back(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        hot = x.copy()
+        hot[:, 9] = hot[:, 7]  # earliest_cr_line == issue_d -> diff = 0
+        out = self._attack(cons, scaler).generate(x, hot_start=hot)[:, 0, :]
+        np.testing.assert_allclose(out, x)

@@ -17,6 +17,10 @@ Subpackages
 - ``attacks``   MoEvA2 (evolutionary), PGD/AutoPGD (gradient), MIP (exact), objectives
   (device kernels — non-dominated sort, niching, GA operators, ref dirs — live
   under ``attacks/moeva``; mesh sharding is built into the engines)
+- ``experiments`` L4/L5 runners: MoEvA/PGD/SAT entry points, RQ1-RQ4/SM1 grids,
+  defense pipelines (augmentation + adversarial retraining), run_all
+- ``utils``     layered config + md5 experiment identity, file IO, metrics-record
+  streaming, phase timers / profiling
 """
 
 __version__ = "0.1.0"

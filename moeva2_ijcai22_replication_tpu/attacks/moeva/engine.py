@@ -33,6 +33,7 @@ from ...core.constraints import ConstraintSet
 from ...core.norms import is_l2, lp_distance, validate_norm
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
+from .initialisation import lp_ratio_init, tile_init
 from .operators import OperatorTables, make_operator_tables, make_offspring
 from .refdirs import energy_ref_dirs, rnsga3_geometry
 from .survival import NormState, survive
@@ -74,7 +75,17 @@ class Moeva2:
     crossover_prob: float = 0.9
     eta_mutation: float = 20.0
     seed: int = 0
+    #: initial-population strategy: "tile" (InitialStateSampling parity) or
+    #: "lp_ratio" (MixedSamplingLp parity — perturb ``init_ratio`` of the
+    #: population inside an ``init_eps`` Lp ball in normalised genetic space).
+    init: str = "tile"
+    init_eps: float = 0.1
+    init_ratio: float = 0.5
     save_history: str | None = None
+    #: generations per jitted scan segment when history is recorded; each
+    #: segment's records are offloaded to host so "full" history at rq1 scale
+    #: (1000 gens) never accumulates on device.
+    history_chunk: int = 50
     dtype: Any = jnp.float32
     mesh: jax.sharding.Mesh | None = None
     states_axis: str = "states"
@@ -99,7 +110,10 @@ class Moeva2:
             raise ValueError(
                 f"save_history must be None, 'reduced' or 'full', got {self.save_history!r}"
             )
-        self._jit_attack = None
+        if self.init not in ("tile", "lp_ratio"):
+            raise ValueError(f"init must be 'tile' or 'lp_ratio', got {self.init!r}")
+        self._jit_init = None
+        self._jit_segment = None
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
@@ -123,46 +137,82 @@ class Moeva2:
         g_all = self.constraints.evaluate(x_f)
         return jnp.stack([f1, f2, g_all.sum(-1)], axis=-1), g_all
 
-    # -- attack program -----------------------------------------------------
-    def _build_attack(self):
+    def _evaluate_hist(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
+        """Evaluate + the per-evaluation history record.
+
+        History parity (``default_problem.py:137-140``): "reduced" records F
+        per evaluation, "full" appends per-constraint G.
+        """
+        f, g_all = self._evaluate(
+            params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class
+        )
+        if self.save_history == "full":
+            return f, jnp.concatenate([f, g_all], axis=-1)
+        return f, f
+
+    # -- attack programs ----------------------------------------------------
+    # The attack is two jitted programs: ``init`` (initial population +
+    # normalisation warm-up) and ``segment`` (a lax.scan over a static number
+    # of generations). ``generate`` chains segments, offloading each
+    # segment's history records to host between dispatches so "full" history
+    # at rq1 scale never accumulates in HBM; without history there is exactly
+    # one segment, i.e. the round-2 single-scan program.
+
+    def _build_init(self):
+        codec = self.codec
+        pop_size = self.pop_size
+        asp = self.asp_points
+
+        def init(params, x_init_ml, minimize_class, xl_ml, xu_ml, key):
+            eng = self  # close over static config
+            s = x_init_ml.shape[0]
+            xl_gen, xu_gen = codec_lib.genetic_bounds(codec, xl_ml, xu_ml)
+            x_init_mm = codec_lib.minmax_normalize(x_init_ml, xl_ml, xu_ml)
+
+            key, k_init, k0 = jax.random.split(key, 3)
+            if eng.init == "lp_ratio":
+                pop_x = lp_ratio_init(
+                    k_init,
+                    codec,
+                    x_init_ml,
+                    pop_size,
+                    xl_gen,
+                    xu_gen,
+                    eps=eng.init_eps,
+                    ratio=eng.init_ratio,
+                    norm=eng.norm,
+                ).astype(eng.dtype)
+            else:
+                pop_x = tile_init(codec, x_init_ml, pop_size).astype(eng.dtype)
+            pop_f, init_hist = eng._evaluate_hist(
+                params, pop_x, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class
+            )
+
+            # Initialisation survival: everyone survives, normalisation state
+            # (ideal/worst/extreme) warms up — pymoo GeneticAlgorithm._initialize.
+            norm0 = jax.vmap(lambda _: NormState.init(3, eng.dtype))(jnp.arange(s))
+            _, norm_state, _ = jax.vmap(
+                lambda k, f, st: survive(k, f, asp, st, pop_size)
+            )(jax.random.split(k0, s), pop_f, norm0)
+
+            if not eng.save_history:
+                init_hist = jnp.zeros((), eng.dtype)
+            return (pop_x, pop_f, norm_state, key), init_hist
+
+        return init
+
+    def _build_segment(self):
         codec = self.codec
         tables = self.tables
         pop_size = self.pop_size
         n_off = self.n_offsprings
         asp = self.asp_points
 
-        def attack(params, x_init_ml, minimize_class, xl_ml, xu_ml, key):
-            eng = self  # close over static config
+        def segment(params, x_init_ml, minimize_class, xl_ml, xu_ml, carry, length):
+            eng = self
             s = x_init_ml.shape[0]
-
             xl_gen, xu_gen = codec_lib.genetic_bounds(codec, xl_ml, xu_ml)
             x_init_mm = codec_lib.minmax_normalize(x_init_ml, xl_ml, xu_ml)
-
-            def evaluate(x_gen):
-                f, g_all = eng._evaluate(
-                    params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class
-                )
-                # History parity (default_problem.py:137-140): "reduced"
-                # records F per evaluation, "full" appends per-constraint G.
-                if eng.save_history == "full":
-                    return f, jnp.concatenate([f, g_all], axis=-1)
-                return f, f
-
-            x0 = codec_lib.round_int_genes(
-                codec, codec_lib.ml_to_genetic(codec, x_init_ml)
-            )
-            pop_x = jnp.broadcast_to(
-                x0[:, None, :], (s, pop_size, codec.gen_length)
-            ).astype(eng.dtype)
-            pop_f, init_hist = evaluate(pop_x)
-
-            # Initialisation survival: everyone survives, normalisation state
-            # (ideal/worst/extreme) warms up — pymoo GeneticAlgorithm._initialize.
-            norm0 = jax.vmap(lambda _: NormState.init(3, eng.dtype))(jnp.arange(s))
-            key, k0 = jax.random.split(key)
-            _, norm_state, _ = jax.vmap(
-                lambda k, f, st: survive(k, f, asp, st, pop_size)
-            )(jax.random.split(k0, s), pop_f, norm0)
 
             def gen_step(carry, _):
                 pop_x, pop_f, norm_state, key = carry
@@ -180,7 +230,9 @@ class Moeva2:
                         eta_mutation=eng.eta_mutation,
                     )
                 )(jax.random.split(k_mate, s), pop_x, xl_gen, xu_gen)
-                off_f, off_hist = evaluate(off)
+                off_f, off_hist = eng._evaluate_hist(
+                    params, off, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class
+                )
 
                 merged_x = jnp.concatenate([pop_x, off], axis=1)
                 merged_f = jnp.concatenate([pop_f, off_f], axis=1)
@@ -197,14 +249,9 @@ class Moeva2:
                 hist = off_hist if eng.save_history else jnp.zeros((), eng.dtype)
                 return (pop_x, pop_f, norm_state, key), hist
 
-            (pop_x, pop_f, _, _), hist = jax.lax.scan(
-                gen_step, (pop_x, pop_f, norm_state, key), None, length=eng.n_gen - 1
-            )
-            if not eng.save_history:
-                init_hist = jnp.zeros((), eng.dtype)
-            return pop_x, pop_f, (init_hist, hist)
+            return jax.lax.scan(gen_step, carry, None, length=length)
 
-        return attack
+        return segment
 
     # -- public API ---------------------------------------------------------
     def generate(self, x: np.ndarray, minimize_class=1) -> MoevaResult:
@@ -234,8 +281,11 @@ class Moeva2:
         xl_ml = np.broadcast_to(np.asarray(xl_ml, dtype=np.float64), x.shape)
         xu_ml = np.broadcast_to(np.asarray(xu_ml, dtype=np.float64), x.shape)
 
-        if self._jit_attack is None:
-            self._jit_attack = jax.jit(self._build_attack())
+        if self._jit_init is None:
+            self._jit_init = jax.jit(self._build_init())
+            self._jit_segment = jax.jit(
+                self._build_segment(), static_argnames="length"
+            )
 
         args = (
             self.classifier.params,
@@ -247,16 +297,45 @@ class Moeva2:
         )
         if self.mesh is not None:
             args = self._shard_args(args)
+        params, x_dev, mc_dev, xl_dev, xu_dev, key = args
 
         t0 = time.time()
-        pop_x, pop_f, (init_hist, gen_hist) = self._jit_attack(*args)
+        carry, init_hist = self._jit_init(*args)
+        n_steps = self.n_gen - 1
+        # Without history a single segment reproduces the one-scan program;
+        # with history, fixed-size segments bound HBM usage and each chunk's
+        # records move to host while the next segment runs.
+        chunk = n_steps if not self.save_history else max(1, self.history_chunk)
+        hist_chunks = []
+        pending = None  # previous chunk's device buffer, fetched one dispatch late
+        done = 0
+        while done < n_steps:
+            length = min(chunk, n_steps - done)
+            carry, gen_hist = self._jit_segment(
+                params, x_dev, mc_dev, xl_dev, xu_dev, carry, length=length
+            )
+            if self.save_history:
+                # the next segment is already enqueued (async dispatch), so
+                # this transfer overlaps with its compute
+                if pending is not None:
+                    hist_chunks.append(np.asarray(jax.device_get(pending)))
+                pending = gen_hist
+            done += length
+        if pending is not None:
+            hist_chunks.append(np.asarray(jax.device_get(pending)))
+        pop_x, pop_f, _, _ = carry
         pop_x, pop_f = jax.device_get((pop_x, pop_f))
         elapsed = time.time() - t0
 
         history = None
         if self.save_history:
             init_hist = np.asarray(jax.device_get(init_hist))
-            gen_hist = np.asarray(jax.device_get(gen_hist))  # (n_gen-1, S, O, C)
+            # (n_gen-1, S, O, C) across chunks
+            gen_hist = (
+                np.concatenate(hist_chunks, axis=0)
+                if hist_chunks
+                else np.zeros((0, *init_hist.shape))
+            )
             history = [init_hist] + [gen_hist[i] for i in range(gen_hist.shape[0])]
 
         x_ml = np.asarray(

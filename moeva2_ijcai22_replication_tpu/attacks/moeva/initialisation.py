@@ -1,0 +1,84 @@
+"""Initial-population strategies for the MoEvA2 engine, on device.
+
+Capability parity with the reference's two samplers
+(``/root/reference/src/attacks/moeva2/sampling.py``):
+
+* ``tile`` — every individual starts at the encoded initial state, integer
+  genes rounded (``InitialStateSampling``, ``sampling.py:55-78``).
+* ``lp_ratio`` — a fixed fraction of the population is perturbed inside an
+  Lp ε-ball in normalised genetic space, clipped to bounds, denormalised,
+  integer genes rounded; the rest stays at the initial state
+  (``MixedSamplingLp``, ``sampling.py:8-52`` with the hyperball/Linf
+  samplers of ``src/utils/__init__.py:22-41``).
+
+TPU-first formulation: both strategies are pure jittable functions over the
+whole ``(n_states, n_pop, L)`` batch at once (the reference samples one
+state per joblib worker with numpy's global RNG); the ball sampler uses the
+Gaussian-direction trick as a single batched normal draw.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import codec as codec_lib
+from ...core.codec import Codec
+from ...core.norms import is_inf, is_l2
+
+
+def ball_sample(key: jax.Array, shape: tuple, eps: float, norm) -> jnp.ndarray:
+    """Uniform perturbations inside the Lp ε-ball, shape ``(..., d)``.
+
+    L2 uses the (d+2)-dimensional Gaussian projection trick (marginals of a
+    uniform ball point); L∞ is a plain uniform cube.
+    """
+    d = shape[-1]
+    if is_l2(norm):
+        u = jax.random.normal(key, (*shape[:-1], d + 2))
+        u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+        return u[..., :d] * eps
+    if is_inf(norm):
+        return jax.random.uniform(key, shape, minval=-1.0, maxval=1.0) * eps
+    raise NotImplementedError(f"no ball sampler for norm {norm!r}")
+
+
+def tile_init(codec: Codec, x_init_ml: jnp.ndarray, n_pop: int) -> jnp.ndarray:
+    """(S, D) initial states -> (S, n_pop, L) genetic population, all rows at
+    the (int-rounded) encoded initial state."""
+    x0 = codec_lib.round_int_genes(codec, codec_lib.ml_to_genetic(codec, x_init_ml))
+    s = x_init_ml.shape[0]
+    return jnp.broadcast_to(x0[:, None, :], (s, n_pop, codec.gen_length))
+
+
+def lp_ratio_init(
+    key: jax.Array,
+    codec: Codec,
+    x_init_ml: jnp.ndarray,
+    n_pop: int,
+    xl_gen: jnp.ndarray,
+    xu_gen: jnp.ndarray,
+    eps: float = 0.1,
+    ratio: float = 0.5,
+    norm=2,
+) -> jnp.ndarray:
+    """Tile + perturb the last ``round(ratio * n_pop)`` individuals in the
+    normalised genetic box (clip to [0,1], denormalise, round int genes).
+
+    The perturbed rows sit *last*, matching the reference's concatenation
+    order (``sampling.py:48-50``).
+    """
+    pop = tile_init(codec, x_init_ml, n_pop)
+    n_pert = int(round(ratio * n_pop))
+    if n_pert == 0:
+        return pop
+    s = x_init_ml.shape[0]
+    rng = (xu_gen - xl_gen)[:, None, :]
+    # zero-range genes: divide by the guard but denormalise by the true
+    # (zero) range, so they stay pinned at their single feasible value
+    safe = jnp.where(rng > 0, rng, 1.0)
+    base = (pop[:, -n_pert:, :] - xl_gen[:, None, :]) / safe
+    delta = ball_sample(key, (s, n_pert, codec.gen_length), eps, norm)
+    pert = jnp.clip(base + delta, 0.0, 1.0) * rng + xl_gen[:, None, :]
+    pert = codec_lib.round_int_genes(codec, pert)
+    return pop.at[:, -n_pert:, :].set(pert)

@@ -14,6 +14,12 @@ tables (position of each gene within its type sub-vector), so a per-type
 two-point crossover is one comparison against two sampled cut points —
 no ragged sub-vectors, no gathers. Everything broadcasts over leading batch
 axes ``(n_states, n_matings, ...)`` and is vmap/shard_map-safe.
+
+Deliberate gap: the reference also registers softmax-renormalising crossover
+and mutation operators for a "softmax" gene type
+(``softmax_crossover.py:9-42``, ``softmax_mutation.py:8-71``), but the type
+mask that would activate them is commented out (``moeva2.py:89``) and no
+dataset declares softmax genes — dead code by construction, not ported.
 """
 
 from __future__ import annotations

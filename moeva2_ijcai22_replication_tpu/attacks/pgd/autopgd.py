@@ -97,7 +97,7 @@ class AutoPGD(ConstrainedPGD):
                 params, c["x"], y, i
             )
             hist = (
-                self._hist_record(c["hist"], i, per, loss_class, cons, g)
+                self._hist_record(c["hist"], i, per, loss_class, cons, g, grad)
                 if self.record_loss
                 else c["hist"]
             )

@@ -60,6 +60,11 @@ class ConstrainedPGD:
     #: reference's TF2Classifier history, ``classifier.py:276-296``);
     #: exposed as ``loss_history`` (N, max_iter, C) after ``generate``.
     record_loss: str | None = None
+    #: with ``record_loss``, also record the per-sample L2 norm of the raw
+    #: loss gradient each iteration (parity with the reference's TensorBoard
+    #: grad-norm stream, ``atk.py:201-226``) as an extra column after
+    #: cons_sum and before any "full" per-constraint columns.
+    record_grad_norm: bool = False
 
     def __post_init__(self):
         self._mutable = jnp.asarray(
@@ -152,20 +157,24 @@ class ConstrainedPGD:
         return self.eps_step
 
     def _hist_columns(self) -> int:
-        """History column count: [loss, loss_class, cons_sum] + per-constraint
-        violations for "full" (``classifier.py:276-296``)."""
+        """History column count: [loss, loss_class, cons_sum] (+ grad_norm
+        under ``record_grad_norm``) + per-constraint violations for "full"
+        (``classifier.py:276-296``)."""
         if not self.record_loss:
             return 0
         k = self.constraints.n_constraints if "full" in self.record_loss else 0
-        return 3 + k
+        return 3 + int(self.record_grad_norm) + k
 
     def _hist_init(self, n, dtype):
         if self.record_loss:
             return jnp.zeros((self.max_iter, n, self._hist_columns()), dtype)
         return jnp.zeros((), dtype)
 
-    def _hist_record(self, hist, i, per, loss_class, cons, g):
+    def _hist_record(self, hist, i, per, loss_class, cons, g, grad):
         cols = [per, loss_class, cons]
+        if self.record_grad_norm:
+            finite = jnp.nan_to_num(grad, nan=0.0, posinf=0.0, neginf=0.0)
+            cols.append(jnp.sqrt((finite * finite).sum(-1)))
         stacked = jnp.column_stack(
             cols + [g] if "full" in self.record_loss else cols
         )
@@ -180,7 +189,7 @@ class ConstrainedPGD:
             x, hist = carry
             grad, per, loss_class, cons, g = self._grad_and_terms(params, x, y, i)
             if self.record_loss:
-                hist = self._hist_record(hist, i, per, loss_class, cons, g)
+                hist = self._hist_record(hist, i, per, loss_class, cons, g, grad)
             grad = jnp.where(jnp.isnan(grad), 0.0, grad)
             grad = jnp.where(self._mutable, grad, 0.0)
             grad = condition_grad(grad, self.norm)
@@ -225,7 +234,7 @@ class ConstrainedPGD:
                 return self._one_run(params, x_init, y, x_init)
 
             def restart(r, carry):
-                best_x, best_success, _ = carry
+                best_x, best_success, best_hist = carry
                 x_start = self._random_start(jax.random.fold_in(key, r), x_init)
                 x_adv, hist = self._one_run(params, x_init, y, x_start)
                 probs = Surrogate(self.classifier.model, params).predict_proba(x_adv)
@@ -234,8 +243,14 @@ class ConstrainedPGD:
                     success = probs.argmax(-1) == y
                 take = success & ~best_success
                 best_x = jnp.where(take[:, None], x_adv, best_x)
-                # history follows the last restart executed
-                return best_x, best_success | success, hist
+                if self.record_loss:
+                    # history follows the restart whose result was kept;
+                    # still-unsuccessful samples track their latest attempt
+                    upd = take | ~(best_success | success)
+                    best_hist = jnp.where(upd[None, :, None], hist, best_hist)
+                else:
+                    best_hist = hist
+                return best_x, best_success | success, best_hist
 
             best, _, hist = jax.lax.fori_loop(
                 0,

@@ -14,7 +14,6 @@ from ..domains import get_constraints_class
 from ..domains.botnet_sat import make_botnet_sat_builder
 from ..domains.lcld_sat import make_lcld_sat_builder
 from ..models.scalers import MinMaxParams, load_joblib_scaler
-from ..utils import filter_initial_states
 from ..utils.config import get_dict_hash
 from ..utils.in_out import load_model
 
@@ -50,10 +49,11 @@ def load_constraints(config: dict):
 
 
 def load_candidates(config: dict) -> np.ndarray:
+    """Candidate set, sliced to the configured window; ``n_initial_state=-1``
+    keeps everything (``04_moeva.py:55-58``)."""
     x = np.load(config["paths"]["x_candidates"])
-    return filter_initial_states(
-        x, config["initial_state_offset"], config["n_initial_state"]
-    )
+    offset, count = config["initial_state_offset"], config["n_initial_state"]
+    return x if count == -1 else x[offset : offset + count]
 
 
 def load_scaler(config: dict) -> MinMaxParams:

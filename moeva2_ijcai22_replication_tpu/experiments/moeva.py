@@ -58,6 +58,9 @@ def run(config: dict):
         n_pop=config["n_pop"],
         n_offsprings=config["n_offsprings"],
         seed=config["seed"],
+        init=config.get("init", "tile"),
+        init_eps=config.get("init_eps", 0.1),
+        init_ratio=config.get("init_ratio", 0.5),
         save_history=config.get("save_history") or None,
         mesh=common.build_mesh(config),
     )

@@ -63,6 +63,7 @@ def run(config: dict):
         constraints_optim=config.get("constraints_optim", "sum"),
         seed=config["seed"],
         record_loss=config.get("save_history") or None,
+        record_grad_norm=bool(config.get("save_grad_norm")),
     )
     if cls is AutoPGD:
         # AutoPGD defaults (01_pgd_united.py:99-111)
@@ -100,7 +101,7 @@ def run(config: dict):
                 per_attack_eps,
                 np.inf,
                 n_sample=1,
-                n_jobs=config["system"]["n_jobs"],
+                n_jobs=config.get("system", {}).get("n_jobs", 1),
             )
             x_attacks = sat.generate(x_initial, x_attacks)[:, 0, :]
 

@@ -65,7 +65,13 @@ class GridRunner:
             from . import moeva as runner
         else:
             from . import pgd as runner
-        runner.run(cfg)
+        # Same failure isolation as subprocess mode: one bad grid point is
+        # logged and the sweep continues (the reference gets this for free
+        # from its per-point processes).
+        try:
+            runner.run(cfg)
+        except Exception:
+            logger.exception("grid point failed in-process: %s", module)
 
     def _launch_moeva(self, project: str, overrides: list[dict]) -> None:
         cfg = _compose(

@@ -1,67 +1,41 @@
 """Shared experiment utilities.
 
-Parity targets: ``/root/reference/src/utils/__init__.py`` (state filtering,
-Lp-ball samplers, MCC threshold sweep, timing) — plus the layered config
-system (:mod:`.config`), file IO (:mod:`.in_out`), metrics parsing
-(:mod:`.metrics`) and phase timers (:mod:`.observability`).
+Subsystems: layered config + hashing (:mod:`.config`), file IO
+(:mod:`.in_out`), metrics-file flattening (:mod:`.metrics`), phase timers and
+profiling (:mod:`.observability`). The reference's loose helper grab-bag
+(``/root/reference/src/utils/__init__.py``) maps onto the framework as
+follows: the Lp-ball samplers live on device in
+:mod:`..attacks.moeva.initialisation`, the ``@timing`` decorator is
+superseded by :class:`.observability.PhaseTimer`, candidate-set slicing is
+runner plumbing (:func:`..experiments.common.load_candidates`), and the
+decision-threshold sweep is :func:`best_threshold` below.
 """
 
 from __future__ import annotations
 
-import time as _time
-from functools import wraps
-
 import numpy as np
 
 
-def filter_initial_states(x: np.ndarray, start: int, size: int) -> np.ndarray:
-    """Offset+count slice of the candidate set; ``size=-1`` keeps all
-    (``src/utils/__init__.py:15-19``)."""
-    return x[start : start + size] if size > -1 else x
+def best_threshold(y_true, y_proba, step: float = 0.01):
+    """Pick the decision threshold maximising MCC, ``(threshold, score)``.
 
+    Capability parity with the reference's per-threshold loop
+    (``src/utils/__init__.py:44-53``), computed instead from one vectorised
+    confusion-count table: predictions for all thresholds at once via an
+    outer comparison, MCC from the four counts in closed form.
+    """
+    y_true = np.asarray(y_true, dtype=np.float64)
+    thresholds = np.arange(int(1 / step)) * step
+    pred = y_proba[None, :] >= thresholds[:, None]  # (T, N)
 
-def random_sample_hyperball(rng: np.random.Generator, n: int, d: int) -> np.ndarray:
-    """Uniform samples in the unit L2 ball via the (d+2)-Gaussian trick
-    (``src/utils/__init__.py:22-27``)."""
-    u = rng.normal(0.0, 1.0, (n, d + 2))
-    u = u / np.linalg.norm(u, axis=1, keepdims=True)
-    return u[:, :d]
+    pos = y_true.sum()
+    neg = y_true.size - pos
+    tp = pred @ y_true
+    fp = pred.sum(axis=1) - tp
+    fn = pos - tp
+    tn = neg - fp
+    denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    mcc = np.where(denom > 0, (tp * tn - fp * fn) / np.where(denom > 0, denom, 1.0), 0.0)
 
-
-def sample_in_norm(
-    rng: np.random.Generator, n_samples: int, d: int, eps: float, norm
-) -> np.ndarray:
-    """Uniform perturbations inside the ε-ball of the given Lp norm
-    (``src/utils/__init__.py:30-41``)."""
-    if norm in ("2", 2, 2.0):
-        return random_sample_hyperball(rng, n_samples, d) * eps
-    if norm in ("inf", np.inf):
-        return (rng.random((n_samples, d)) * 2.0 - 1.0) * eps
-    raise NotImplementedError(f"norm {norm!r}")
-
-
-def find_best_threshold(y_test, y_proba, metric=None, step: float = 0.01):
-    """Sweep decision thresholds, return (best_threshold, best_metric)
-    (``src/utils/__init__.py:44-53``; default metric = MCC)."""
-    if metric is None:
-        from sklearn.metrics import matthews_corrcoef as metric
-    nb_steps = int(1 / step)
-    values = [
-        metric(y_test, (y_proba >= t / nb_steps).astype(int))
-        for t in range(nb_steps)
-    ]
-    best_i = int(np.argmax(values))
-    return best_i / nb_steps, values[best_i]
-
-
-def timing(f):
-    """Wall-clock decorator (``src/utils/__init__.py:56-65``)."""
-
-    @wraps(f)
-    def wrap(*args, **kw):
-        ts = _time.time()
-        result = f(*args, **kw)
-        print(f"func:{f.__name__!r} took: {_time.time() - ts:2.4f} sec")
-        return result
-
-    return wrap
+    best = int(np.argmax(mcc))
+    return float(thresholds[best]), float(mcc[best])

@@ -1,44 +1,69 @@
-"""Flatten ``metrics_*.json`` files into tabular records.
+"""Post-hoc analysis: stream attack metrics files as flat tabular records.
 
-Parity: ``/root/reference/src/utils/metrics.py`` — one record per (run, ε)
-for MoEvA (``objectives_list``), one per run for PGD (``objectives``).
+Capability parity with the reference's metrics flattener
+(``/root/reference/src/utils/metrics.py`` — one row per (run, ε) for MoEvA,
+one per run for gradient attacks), reshaped as a single generator over a
+results directory so analysis code can do
+``pd.DataFrame(records("./out/attacks/lcld/rq1"))`` without touching file
+layout details.
 """
 
 from __future__ import annotations
 
+import glob
+import json
+import os
+from typing import Iterator
 
-def parse_moeva(metrics: dict) -> list[dict]:
-    config = metrics["config"]
-    return [
-        {
-            "attack_name": config["attack_name"],
-            "eps": config["eps_list"][i],
-            **metrics["objectives_list"][i],
+#: run-level fields lifted from each metrics JSON into every record;
+#: (record key, path into the metrics dict, default)
+_RUN_FIELDS = (
+    ("config_hash", ("config_hash",), None),
+    ("project_name", ("config", "project_name"), None),
+    ("n_state", ("config", "n_initial_state"), None),
+    ("budget", ("config", "budget"), None),
+    ("time", ("time",), None),
+    ("model", ("config", "paths", "model"), None),
+    ("reconstruction", ("config", "reconstruction"), None),
+)
+
+
+def _dig(tree: dict, path: tuple, default=None):
+    for k in path:
+        if not isinstance(tree, dict) or k not in tree:
+            return default
+        tree = tree[k]
+    return tree
+
+
+def iter_records(metrics: dict) -> Iterator[dict]:
+    """Yield one flat record per success-rate table in one metrics dict.
+
+    MoEvA runs carry ``objectives_list`` (one entry per ε of ``eps_list``);
+    gradient runs carry a single ``objectives`` dict keyed by the loss
+    variant. Both flatten to rows with the same columns.
+    """
+    base = {key: _dig(metrics, path, dflt) for key, path, dflt in _RUN_FIELDS}
+    cfg = metrics.get("config", {})
+    if "objectives_list" in metrics:
+        for eps, objectives in zip(cfg["eps_list"], metrics["objectives_list"]):
+            yield {
+                **base,
+                "attack_name": cfg["attack_name"],
+                "eps": eps,
+                **objectives,
+            }
+    else:
+        yield {
+            **base,
+            "attack_name": cfg.get("loss_evaluation", cfg.get("attack_name")),
+            "eps": cfg.get("eps"),
+            **metrics.get("objectives", {}),
         }
-        for i in range(len(metrics["objectives_list"]))
-    ]
 
 
-def parse_pgd(metrics: dict) -> dict:
-    config = metrics["config"]
-    return {
-        "attack_name": config["loss_evaluation"],
-        "eps": config["eps"],
-        **metrics["objectives"],
-    }
-
-
-def parse_metrics(metrics: dict) -> list[dict]:
-    config = metrics["config"]
-    parsed = {
-        "n_state": config["n_initial_state"],
-        "config_hash": metrics["config_hash"],
-        "project_name": config["project_name"],
-        "budget": config["budget"],
-        "time": metrics["time"],
-        "model": config["paths"]["model"],
-        "reconstruction": config.get("reconstruction", None),
-    }
-    if config["attack_name"] == "moeva":
-        return [{**parsed, **rec} for rec in parse_moeva(metrics)]
-    return [{**parsed, **parse_pgd(metrics)}]
+def records(results_dir: str, pattern: str = "metrics_*.json") -> Iterator[dict]:
+    """Stream flat records from every metrics file under ``results_dir``."""
+    for path in sorted(glob.glob(os.path.join(results_dir, pattern))):
+        with open(path) as fh:
+            yield from iter_records(json.load(fh))

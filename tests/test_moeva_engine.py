@@ -153,3 +153,108 @@ class TestMoevaSharded:
         res = moeva.generate(x, minimize_class=1)
         assert res.x_gen.shape[0] == 8
         assert np.isfinite(res.f).all()
+
+
+class TestInitStrategies:
+    def _engine(self, lcld_constraints, surrogate, x, init, **kw):
+        return Moeva2(
+            classifier=surrogate,
+            constraints=lcld_constraints,
+            ml_scaler=_scaler_for(x),
+            norm=2,
+            n_gen=1,  # population after generate == the initial sampling
+            n_pop=20,
+            n_offsprings=10,
+            seed=11,
+            dtype=jnp.float64,
+            init=init,
+            **kw,
+        )
+
+    def test_lp_ratio_init_perturbs_exactly_the_ratio(
+        self, lcld_constraints, surrogate
+    ):
+        x = synth_lcld(3, lcld_constraints.schema, seed=9)
+        moeva = self._engine(
+            lcld_constraints, surrogate, x, "lp_ratio", init_eps=0.3, init_ratio=0.5
+        )
+        res = moeva.generate(x, minimize_class=1)
+        tiled = self._engine(lcld_constraints, surrogate, x, "tile").generate(
+            x, minimize_class=1
+        )
+        n_pert = round(0.5 * moeva.pop_size)
+        keep = moeva.pop_size - n_pert
+        # unperturbed head identical to the tiled population
+        np.testing.assert_allclose(res.x_gen[:, :keep], tiled.x_gen[:, :keep])
+        # perturbed tail: at least one gene moved for nearly every sample
+        moved = np.abs(res.x_gen[:, keep:] - tiled.x_gen[:, keep:]).max(-1) > 0
+        assert moved.mean() > 0.9
+        # ...and samples are distinct from one another (a real distribution)
+        flat = res.x_gen[:, keep:].reshape(3 * n_pert, -1)
+        assert len(np.unique(flat, axis=0)) > n_pert
+
+    def test_lp_ratio_init_respects_bounds_and_types(
+        self, lcld_constraints, surrogate
+    ):
+        x = synth_lcld(3, lcld_constraints.schema, seed=9)
+        moeva = self._engine(
+            lcld_constraints, surrogate, x, "lp_ratio", init_eps=0.5, init_ratio=1.0
+        )
+        res = moeva.generate(x, minimize_class=1)
+        # ML-space invariants survive the perturbed init: bounds + one-hots
+        xl, xu = lcld_constraints.get_feature_min_max(dynamic_input=x)
+        mutable = lcld_constraints.schema.mutable
+        vals = res.x_ml[:, :, mutable]
+        assert (vals >= np.broadcast_to(np.asarray(xl), x.shape)[:, None, mutable] - 1e-9).all()
+        assert (vals <= np.broadcast_to(np.asarray(xu), x.shape)[:, None, mutable] + 1e-9).all()
+        for group in lcld_constraints.schema.ohe_groups():
+            np.testing.assert_allclose(res.x_ml[:, :, group].sum(-1), 1.0)
+
+    def test_lp_ratio_init_ball_radius(self, lcld_constraints, surrogate):
+        from moeva2_ijcai22_replication_tpu.attacks.moeva.initialisation import (
+            ball_sample,
+        )
+
+        key = jax.random.PRNGKey(0)
+        for norm, eps in [(2, 0.25), (np.inf, 0.1)]:
+            s = np.asarray(ball_sample(key, (500, 12), eps, norm))
+            r = np.abs(s).max(-1) if norm is np.inf else np.linalg.norm(s, axis=-1)
+            assert (r <= eps + 1e-9).all()
+            assert r.max() > 0.5 * eps  # actually fills the ball
+
+    def test_rejects_unknown_init(self, lcld_constraints, surrogate):
+        x = synth_lcld(2, lcld_constraints.schema, seed=1)
+        with pytest.raises(ValueError, match="init"):
+            self._engine(lcld_constraints, surrogate, x, "bogus")
+
+
+class TestHistoryChunking:
+    def test_chunked_history_matches_single_scan(
+        self, lcld_constraints, surrogate
+    ):
+        """Host-offloaded segments must reproduce the one-scan program
+        bit-for-bit: same populations, same (n_gen-1, S, n_off, C) records."""
+        x = synth_lcld(2, lcld_constraints.schema, seed=4)
+
+        def run(chunk):
+            moeva = Moeva2(
+                classifier=surrogate,
+                constraints=lcld_constraints,
+                ml_scaler=_scaler_for(x),
+                norm=2,
+                n_gen=7,
+                n_pop=12,
+                n_offsprings=6,
+                seed=5,
+                dtype=jnp.float64,
+                save_history="full",
+                history_chunk=chunk,
+            )
+            return moeva.generate(x, minimize_class=1)
+
+        small, big = run(2), run(999)
+        assert len(small.history) == 7  # init + 6 generations
+        for a, b in zip(small.history, big.history):
+            np.testing.assert_allclose(a, b)
+        np.testing.assert_allclose(small.x_gen, big.x_gen)
+        np.testing.assert_allclose(small.f, big.f)

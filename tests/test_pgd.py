@@ -214,3 +214,68 @@ class TestAutoPgdReviewRegressions:
         adv = atk.generate(xs[:16], y[:16])
         assert np.isfinite(adv).all()
         assert np.abs(adv - xs[:16]).max() <= 0.2 + 1e-5
+
+
+class TestGradNormHistory:
+    def test_grad_norm_column_shape_and_values(self, setup):
+        """record_grad_norm adds one per-iteration column (parity with the
+        reference's TensorBoard grad-norm stream, atk.py:201-226)."""
+        cons, x, xs, y, scaler, sur = setup
+        atk = ConstrainedPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.05, max_iter=7, norm=np.inf,
+            loss_evaluation="constraints+flip",
+            record_loss="reduced", record_grad_norm=True,
+        )
+        atk.generate(xs, y)
+        hist = atk.loss_history
+        assert hist.shape == (xs.shape[0], 7, 4)
+        gn = hist[..., 3]
+        assert np.isfinite(gn).all() and (gn >= 0).all()
+        assert gn.max() > 0  # the loss actually has gradient signal
+
+    def test_grad_norm_column_with_full_history(self, setup):
+        cons, x, xs, y, scaler, sur = setup
+        atk = AutoPGD(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.2, eps_step=0.06, max_iter=6, norm=np.inf,
+            loss_evaluation="constraints+flip",
+            record_loss="full", record_grad_norm=True,
+        )
+        atk.generate(xs, y)
+        # [loss, loss_class, cons_sum, grad_norm, g_1..g_10] on LCLD
+        assert atk.loss_history.shape == (xs.shape[0], 6, 4 + 10)
+
+    def test_restart_history_follows_kept_restart(self, setup):
+        """With restarts, each sample's history must match a full rerun of
+        the restart that produced its kept result, not blanket-follow the
+        last restart executed."""
+        cons, x, xs, y, scaler, sur = setup
+        kw = dict(
+            classifier=sur, constraints=cons, scaler=scaler,
+            eps=0.25, eps_step=0.05, max_iter=5, norm=np.inf,
+            loss_evaluation="flip", record_loss="reduced", seed=3,
+        )
+        atk = ConstrainedPGD(num_random_init=3, **kw)
+        adv = atk.generate(xs, y)
+        hist = atk.loss_history
+
+        # replay each restart r alone (same fold_in(key, r) stream) and
+        # check every sample's recorded history equals one of the replays
+        replays = []
+        for r in range(3):
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            x_start = atk._random_start(
+                _jax.random.fold_in(_jax.random.PRNGKey(3), r),
+                _jnp.asarray(xs, atk.dtype),
+            )
+            _, h = _jax.jit(atk._one_run)(
+                sur.params, _jnp.asarray(xs, atk.dtype),
+                _jnp.asarray(y, _jnp.int32), x_start,
+            )
+            replays.append(np.swapaxes(np.asarray(h), 0, 1))
+        stack = np.stack(replays)  # (R, N, T, C)
+        per_sample = np.abs(stack - hist[None]).max(axis=(2, 3))  # (R, N)
+        assert (per_sample.min(axis=0) < 1e-6).all()

@@ -219,3 +219,40 @@ class TestGridRunner:
         # relaunching the grid skips every point but still counts launches
         assert rq_runner.run(grid) == 2
         assert sum(s.startswith("metrics_") for s in os.listdir(out_dir)) == 2
+
+
+class TestGridFailureIsolation:
+    def test_poisoned_point_continues_in_process(self, artifacts, tmp_path):
+        """One broken grid point (bad model path) must not kill the sweep —
+        in-process mode now matches subprocess-mode isolation."""
+        import yaml
+
+        config_dir = tmp_path / "config"
+        config_dir.mkdir()
+        out_dir = tmp_path / "out"
+
+        good = base_config(artifacts, out_dir)
+        for key in ("attack_name", "budget", "seed", "eps_list", "n_pop", "n_offsprings"):
+            good.pop(key)
+        bad = dict(good)
+        bad["paths"] = dict(good["paths"], model=str(tmp_path / "missing.msgpack"))
+        (config_dir / "moeva.yaml").write_text(
+            yaml.dump({"attack_name": "moeva", "n_pop": 16, "n_offsprings": 8})
+        )
+        (config_dir / "poisoned.static.yaml").write_text(yaml.dump(bad))
+        (config_dir / "good.static.yaml").write_text(yaml.dump(good))
+
+        grid = {
+            "config_dir": str(config_dir),
+            "attacks": ["moeva"],
+            "seeds": [42],
+            "projects": ["poisoned.static", "good.static"],
+            "eps_list": [0.5],
+            "budgets": [3],
+            "loss_evaluations": [],
+        }
+        n = rq_runner.run(grid)
+        assert n == 2
+        names = os.listdir(out_dir)
+        # the good point produced metrics even though the poisoned one failed
+        assert sum(s.startswith("metrics_moeva_") for s in names) == 1

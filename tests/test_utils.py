@@ -1,0 +1,84 @@
+"""Utility-layer tests: threshold sweep and metrics-record streaming."""
+
+import json
+
+import numpy as np
+
+from moeva2_ijcai22_replication_tpu.utils import best_threshold
+from moeva2_ijcai22_replication_tpu.utils.metrics import iter_records, records
+
+
+class TestBestThreshold:
+    def test_matches_per_threshold_mcc_loop(self):
+        from sklearn.metrics import matthews_corrcoef
+
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 300)
+        proba = np.clip(y * 0.4 + rng.random(300) * 0.6, 0, 1)
+
+        t, score = best_threshold(y, proba)
+        # oracle: the reference's explicit loop (src/utils/__init__.py:44-53)
+        grid = [i / 100 for i in range(100)]
+        oracle = [matthews_corrcoef(y, (proba >= g).astype(int)) for g in grid]
+        assert score == max(oracle)
+        assert t == grid[int(np.argmax(oracle))]
+
+    def test_degenerate_all_one_class(self):
+        t, score = best_threshold(np.zeros(10), np.linspace(0, 1, 10))
+        assert score == 0.0 and 0.0 <= t < 1.0
+
+
+class TestMetricsRecords:
+    def _moeva_metrics(self):
+        return {
+            "config_hash": "abc",
+            "time": 1.5,
+            "config": {
+                "attack_name": "moeva",
+                "project_name": "lcld",
+                "n_initial_state": 4,
+                "budget": 100,
+                "eps_list": [0.1, 0.2],
+                "paths": {"model": "m.msgpack"},
+                "reconstruction": False,
+            },
+            "objectives_list": [{"o1": 1.0}, {"o1": 0.5}],
+        }
+
+    def _pgd_metrics(self):
+        return {
+            "config_hash": "def",
+            "time": 2.0,
+            "config": {
+                "attack_name": "pgd",
+                "loss_evaluation": "constraints+flip",
+                "project_name": "botnet",
+                "n_initial_state": -1,
+                "budget": 10,
+                "eps": 4,
+                "paths": {"model": "m2.msgpack"},
+            },
+            "objectives": {"o7": 0.25},
+        }
+
+    def test_moeva_one_record_per_eps(self):
+        recs = list(iter_records(self._moeva_metrics()))
+        assert [r["eps"] for r in recs] == [0.1, 0.2]
+        assert recs[0]["o1"] == 1.0 and recs[1]["o1"] == 0.5
+        assert all(r["config_hash"] == "abc" for r in recs)
+        assert all(r["project_name"] == "lcld" for r in recs)
+
+    def test_pgd_single_record_keyed_by_loss(self):
+        (rec,) = iter_records(self._pgd_metrics())
+        assert rec["attack_name"] == "constraints+flip"
+        assert rec["eps"] == 4 and rec["o7"] == 0.25
+        assert rec["reconstruction"] is None  # absent -> default
+
+    def test_records_streams_a_directory(self, tmp_path):
+        with open(tmp_path / "metrics_moeva_abc.json", "w") as f:
+            json.dump(self._moeva_metrics(), f)
+        with open(tmp_path / "metrics_pgd_def.json", "w") as f:
+            json.dump(self._pgd_metrics(), f)
+        recs = list(records(str(tmp_path)))
+        assert len(recs) == 3
+        assert {r["attack_name"] for r in recs} == {"moeva", "constraints+flip"}

@@ -1,6 +1,15 @@
 """Headline benchmark: MoEvA2 on LCLD at the north-star budget.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
+the extra keys record BOTH timings — ``steady_s`` (second call, compiled
+program cached in-process) and ``cold_s`` (first call, including jit compile
+or persistent-cache load) — plus ``speedup_cold`` and a ``real_botnet``
+sub-record measured on the reference's committed 387×756 candidate set and
+Keras model (no synthetic data). The headline ``value`` is judged on the
+STEADY number: the north star targets the recurring per-experiment cost of
+the rq1 grid (many runs of one compiled program), and the one-time compile
+is amortised by the persistent cache across bench invocations; ``cold_s``
+is reported alongside so the amortisation is visible, not hidden.
 
 The reference publishes no absolute numbers (BASELINE.md) and cannot run in
 this image (pymoo/autograd absent), so the CPU denominator is *measured
@@ -96,6 +105,63 @@ def measure_ref_pergen() -> float:
     return t_fwd + t_cons
 
 
+def run_real_botnet() -> dict | None:
+    """Second metric on REAL reference inputs (no synthetic data): MoEvA on
+    the committed 387×756 botnet candidate set against the committed Keras
+    model, o-rates at the rq2 ε=4 setting. Budget via BENCH_BOTNET_GENS."""
+    if os.environ.get("BENCH_SKIP_BOTNET"):
+        return None
+    n_gen = int(os.environ.get("BENCH_BOTNET_GENS", 100))
+    try:
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+        from moeva2_ijcai22_replication_tpu.attacks.objective import (
+            ObjectiveCalculator,
+        )
+        from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+        from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+        from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+        base = "/root/reference"
+        cons = BotnetConstraints(
+            f"{base}/data/botnet/features.csv", f"{base}/data/botnet/constraints.csv"
+        )
+        x = np.load(f"{base}/data/botnet/x_candidates_common.npy")
+        sur = load_classifier(f"{base}/models/botnet/nn.model")
+        scaler = load_joblib_scaler(f"{base}/models/botnet/scaler.joblib")
+        moeva = Moeva2(
+            classifier=sur, constraints=cons, ml_scaler=scaler,
+            norm=2, n_gen=n_gen, n_pop=200, n_offsprings=100, seed=42,
+        )
+        t0 = time.time()
+        res = moeva.generate(x, minimize_class=1)
+        cold = time.time() - t0
+        t0 = time.time()
+        res = moeva.generate(x, minimize_class=1)
+        steady = time.time() - t0
+        calc = ObjectiveCalculator(
+            classifier=sur, constraints=cons,
+            thresholds={"f1": 0.5, "f2": 4.0},
+            min_max_scaler=scaler, ml_scaler=scaler,
+            minimize_class=1, norm=2,
+        )
+        rates = [round(float(r), 4) for r in calc.success_rate_3d(x, res.x_ml)]
+        log(
+            f"[bench] real botnet ({x.shape[0]} states x {n_gen} gens): "
+            f"{steady:.1f}s steady / {cold:.1f}s cold; o1..o7 @eps=4: "
+            + " ".join(f"{r:.3f}" for r in rates)
+        )
+        return {
+            "n_states": int(x.shape[0]),
+            "n_gen": n_gen,
+            "steady_s": round(steady, 2),
+            "cold_s": round(cold, 2),
+            "o_rates_eps4": rates,
+        }
+    except Exception as e:
+        log(f"[bench] real-botnet metric skipped: {e}")
+        return None
+
+
 def main():
     import jax
 
@@ -170,18 +236,54 @@ def main():
     except Exception as e:
         log(f"[bench] success-rate eval skipped: {e}")
 
+    # stage split (objective kernel / +operators / full step) for the log
+    if not os.environ.get("BENCH_SKIP_PROFILE"):
+        import subprocess
+
+        # run on CPU: the parent process holds the (single) TPU chip, and the
+        # split's purpose is relative stage cost, not absolute time
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(
+            os.environ,
+            P_STATES=str(min(N_STATES, 64)),
+            P_GENS="10",
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=repo_root
+            + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        )
+        prof = subprocess.run(
+            [sys.executable, os.path.join(repo_root, "tools", "profile_moeva.py")],
+            capture_output=True, text=True, env=env,
+        )
+        split = [l for l in prof.stdout.splitlines() if "ms/gen" in l]
+        for line in split:
+            log(f"[bench] stage(cpu) {line.strip()}")
+        if not split:
+            tail = prof.stderr.strip().splitlines()[-1][:200] if prof.stderr.strip() else ""
+            log(f"[bench] stage split unavailable (rc={prof.returncode}): {tail}")
+
+    real_botnet = run_real_botnet()
+
     t_pergen = measure_ref_pergen()
     cores = os.cpu_count() or 1
     ref_s = t_pergen * N_STATES * N_GEN / cores
     log(f"[bench] ref CPU estimate: {ref_s:.1f}s (perfect {cores}-core scaling assumed)")
 
     speedup = ref_s / ours_s
-    print(json.dumps({
+    record = {
         "metric": "lcld_rq1_moeva_wallclock_speedup_vs_cpu_ref_estimate",
         "value": round(speedup, 2),
         "unit": "x",
         "vs_baseline": round(speedup, 2),
-    }))
+        "basis": "steady",
+        "steady_s": round(ours_s, 2),
+        "cold_s": round(cold_s, 2),
+        "speedup_cold": round(ref_s / cold_s, 2),
+    }
+    if real_botnet:
+        record["real_botnet"] = real_botnet
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

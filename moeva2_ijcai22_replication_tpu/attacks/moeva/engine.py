@@ -338,13 +338,22 @@ class Moeva2:
             )
             history = [init_hist] + [gen_hist[i] for i in range(gen_hist.shape[0])]
 
-        x_ml = np.asarray(
-            jax.device_get(
+        # Decode the final populations on the host CPU backend: the genetic
+        # tensor already crossed host↔device once, and decoding there avoids
+        # a second full-population transfer (measurable when the accelerator
+        # sits behind a network tunnel).
+        try:
+            decode_dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            decode_dev = None
+        with jax.default_device(decode_dev):
+            x_ml = np.asarray(
                 codec_lib.genetic_to_ml(
-                    self.codec, jnp.asarray(pop_x), jnp.asarray(x, self.dtype)[:, None, :]
+                    self.codec,
+                    jnp.asarray(pop_x),
+                    jnp.asarray(x, self.dtype)[:, None, :],
                 )
             )
-        )
         return MoevaResult(
             x_gen=np.asarray(pop_x),
             f=np.asarray(pop_f),

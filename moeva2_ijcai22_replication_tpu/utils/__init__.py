@@ -25,7 +25,10 @@ def best_threshold(y_true, y_proba, step: float = 0.01):
     outer comparison, MCC from the four counts in closed form.
     """
     y_true = np.asarray(y_true, dtype=np.float64)
-    thresholds = np.arange(int(1 / step)) * step
+    nb_steps = int(1 / step)
+    # i/nb_steps, not i*step: float accumulation would shift grid points
+    # (35*0.01 != 0.35) and misclassify probabilities sitting exactly on one
+    thresholds = np.arange(nb_steps) / nb_steps
     pred = y_proba[None, :] >= thresholds[:, None]  # (T, N)
 
     pos = y_true.sum()

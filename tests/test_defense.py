@@ -150,3 +150,102 @@ class TestRq4Pipeline:
         config["dirs"] = {"data": str(tmp_path), "models": str(tmp_path)}
         with pytest.raises(FileNotFoundError):
             rq4.run(config)
+
+
+@pytest.fixture(scope="module")
+def botnet_pipeline_out(tmp_path_factory, botnet_paths, botnet_candidates):
+    """Botnet defense pipeline on the real (constraint-valid) candidate set
+    with a synthetic learnable label — exercises the botnet knobs: 19
+    important features / ``_19`` artifact suffix, untargeted gradient
+    adversarials, no gradient-defended model, and no constraint filter on
+    the common candidates (botnet/01_train_robust.py)."""
+    tmp = tmp_path_factory.mktemp("botnet_defense")
+    x_all = botnet_candidates[:96].astype(float)
+    # learnable target: above-median value of the highest-variance mutable col
+    from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+
+    cons = BotnetConstraints(botnet_paths["features"], botnet_paths["constraints"])
+    mut = np.flatnonzero(cons.get_mutable_mask())
+    j = mut[np.argmax(x_all[:, mut].std(0))]
+    y_all = (x_all[:, j] > np.median(x_all[:, j])).astype(np.int64)
+    x_train, x_test = x_all[:64], x_all[64:]
+    y_train, y_test = y_all[:64], y_all[64:]
+    for name, arr in [
+        ("x_train", x_train), ("x_test", x_test),
+        ("y_train", y_train), ("y_test", y_test),
+    ]:
+        np.save(tmp / f"{name}.npy", arr)
+
+    config = {
+        "project_name": "botnet",
+        "paths": {
+            "features": botnet_paths["features"],
+            "constraints": botnet_paths["constraints"],
+            "x_train": str(tmp / "x_train.npy"),
+            "x_test": str(tmp / "x_test.npy"),
+            "y_train": str(tmp / "y_train.npy"),
+            "y_test": str(tmp / "y_test.npy"),
+        },
+        "dirs": {"data": str(tmp / "data"), "models": str(tmp / "models")},
+        "misclassification_threshold": 0.5,
+        "norm": 2,
+        "eps": 4.0,
+        "seed": 42,
+        "budget": 3,
+        "n_pop": 8,
+        "n_offsprings": 4,
+        "system": {"n_jobs": 1, "verbose": 0},
+        "defense": {"epochs": 4, "balanced_n": 24},
+    }
+    artifacts = defense.run(config)
+    return dict(tmp=tmp, config=config, artifacts=artifacts, cons=cons,
+                x_test=x_test, y_test=y_test)
+
+
+class TestBotnetDefensePipeline:
+    def test_botnet_knobs_artifact_family(self, botnet_pipeline_out):
+        a = botnet_pipeline_out["artifacts"]
+        tmp = botnet_pipeline_out["tmp"]
+        # _19 suffix on importance + augmented artifacts (botnet reference)
+        assert a["important_features"].endswith("important_features_19.npy")
+        assert a["nn_augmented"].endswith("nn_augmented_19.msgpack")
+        assert os.path.exists(tmp / "data" / "features_augmented_19.csv")
+        assert os.path.exists(tmp / "models" / "scaler_augmented_19.joblib")
+        # botnet trains no gradient-defended model
+        assert a["nn_gradient"] is None
+        for key in ("scaler", "nn", "nn_augmented", "nn_moeva",
+                    "x_candidates_common", "x_candidates_common_augmented"):
+            assert a[key] and os.path.exists(a[key]), key
+
+    def test_botnet_importance_19(self, botnet_pipeline_out):
+        imp = np.load(botnet_pipeline_out["artifacts"]["important_features"])
+        assert imp.shape == (19, 2)
+        cons = botnet_pipeline_out["cons"]
+        mutable = np.flatnonzero(cons.get_mutable_mask())
+        assert set(imp[:, 0].astype(int)) <= set(mutable.tolist())
+
+    def test_botnet_augmented_width(self, botnet_pipeline_out):
+        a = botnet_pipeline_out["artifacts"]
+        x_aug = np.load(a["x_candidates_common_augmented"])
+        # comb(19, 2) = 171 XOR pair features on top of the 756
+        assert x_aug.shape[1] == 756 + 171
+
+    def test_botnet_common_candidates(self, botnet_pipeline_out):
+        """label-1, correctly classified by every model; the constraint
+        filter is OFF for botnet (common_requires_constraints=False)."""
+        from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+        import joblib
+
+        a = botnet_pipeline_out["artifacts"]
+        x_cand = np.load(a["x_candidates_common"])
+        assert x_cand.shape[0] >= 1 and x_cand.shape[1] == 756
+        scaler = joblib.load(a["scaler"])
+        for key in ("nn", "nn_moeva"):
+            sur = load_classifier(a[key])
+            proba = np.asarray(sur.predict_proba(scaler.transform(x_cand)))[:, 1]
+            assert (proba >= 0.5).all(), f"{key} misclassifies candidates"
+
+    def test_botnet_memoization(self, botnet_pipeline_out, capsys):
+        artifacts = defense.run(botnet_pipeline_out["config"])
+        assert artifacts == botnet_pipeline_out["artifacts"]
+        assert "exists loading..." in capsys.readouterr().out

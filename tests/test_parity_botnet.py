@@ -1,0 +1,100 @@
+"""Reference-parity fixtures on the REAL committed botnet artifacts.
+
+The reference itself cannot execute in this image (pymoo/ART/gurobipy are
+absent), so parity is pinned operationally, on the reference's own data:
+attacks run against ``/root/reference``'s committed 387×756 candidate set,
+Keras model, and scaler (`config/rq1.botnet.static.yaml` settings —
+threshold 0.5, L2, ε from the rq2/sm1 grids), and the resulting o1..o7
+tables (metric definition: ``objective_calculator.py:86-119``) are committed
+as fixtures that CI re-derives:
+
+- ``parity_botnet_rq1.json`` — the full-scale run record (387 states ×
+  1000 generations, pop 200, seed 42, single TPU chip, 76.8 s) plus a pinned
+  8-state/24-candidate slice of its attack output
+  (``parity_botnet_{x,adv}.npy``) whose o-rates CI recomputes bit-for-bit.
+- ``parity_botnet_cpu_small.json`` — a small attack (16 states × 40 gens)
+  re-RUN from scratch in CI on the deterministic CPU backend and checked
+  against its pinned rates.
+
+Full-scale numbers for the record (budget 1000): MoEvA o1..o7 =
+[1, 1, 1, .0749, 1, 1, .0749] (f64 re-evaluation; the on-TPU f32 evaluation
+reports .072 — two boundary states); PGD(flip) flips every state but
+satisfies constraints nowhere (o2=1, o1=o7=0); PGD(constraints+flip) stops
+flipping (o2=0) — the reference paper's qualitative botnet story.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.objective import ObjectiveCalculator
+from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+REF_MODELS = "/root/reference/models"
+
+
+@pytest.fixture(scope="module")
+def real_botnet(botnet_paths):
+    if not os.path.isdir(REF_MODELS):
+        pytest.skip("reference models not available")
+    cons = BotnetConstraints(botnet_paths["features"], botnet_paths["constraints"])
+    sur = load_classifier(f"{REF_MODELS}/botnet/nn.model")
+    scaler = load_joblib_scaler(f"{REF_MODELS}/botnet/scaler.joblib")
+    return cons, sur, scaler
+
+
+def make_calc(cons, sur, scaler, thresholds):
+    return ObjectiveCalculator(
+        classifier=sur, constraints=cons, thresholds=thresholds,
+        min_max_scaler=scaler, ml_scaler=scaler, minimize_class=1, norm=2,
+    )
+
+
+class TestMetricPipelinePinned:
+    def test_slice_o_rates_bit_for_bit(self, real_botnet):
+        """The committed slice of the full-scale TPU attack output must
+        reproduce its pinned o1..o7 exactly — pins the entire evaluation
+        pipeline (360 constraint kernels, OHE distance, scaler, imported
+        Keras forward, thresholds) against the real artifacts."""
+        cons, sur, scaler = real_botnet
+        rec = json.load(open(f"{FIXTURES}/parity_botnet_rq1.json"))
+        x = np.load(f"{FIXTURES}/parity_botnet_x.npy")
+        adv = np.load(f"{FIXTURES}/parity_botnet_adv.npy").astype(np.float64)
+        calc = make_calc(cons, sur, scaler, rec.get("thresholds", {"f1": 0.5, "f2": 4.0}))
+        rates = calc.success_rate_3d(x, adv)
+        np.testing.assert_allclose(rates, rec["slice_o_rates"], atol=0)
+
+    def test_full_scale_record_consistency(self):
+        rec = json.load(open(f"{FIXTURES}/parity_botnet_rq1.json"))
+        o = np.asarray(rec["full_scale"]["o_rates"])
+        assert rec["full_scale"]["n_states"] == 387
+        assert rec["full_scale"]["n_gen"] == 1000
+        # metric algebra: joint rates can never exceed their factors
+        assert o[3] <= min(o[0], o[1]) and o[6] <= min(o[3], o[4], o[5])
+        # the run found genuine constrained adversarials
+        assert o[6] > 0
+
+
+class TestSmallAttackReproduces:
+    def test_cpu_small_run_matches_pinned_rates(self, real_botnet, botnet_candidates):
+        """End-to-end determinism fixture: the same small MoEvA attack on the
+        first 16 real candidates must land on the pinned o-rates (CPU x64
+        backend — the CI platform the fixture was generated on)."""
+        cons, sur, scaler = real_botnet
+        rec = json.load(open(f"{FIXTURES}/parity_botnet_cpu_small.json"))
+        x = botnet_candidates[: rec["n_states"]]
+        moeva = Moeva2(
+            classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
+            n_gen=rec["n_gen"], n_pop=rec["n_pop"],
+            n_offsprings=rec["n_offsprings"], seed=rec["seed"],
+        )
+        res = moeva.generate(x, minimize_class=1)
+        calc = make_calc(cons, sur, scaler, rec["thresholds"])
+        rates = calc.success_rate_3d(x, res.x_ml)
+        np.testing.assert_allclose(rates, rec["o_rates"], atol=0)

@@ -1,0 +1,128 @@
+"""Training-loop tests: early stopping, class/sample weights, auroc, DP mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.models.mlp import MLP, lcld_mlp
+from moeva2_ijcai22_replication_tpu.models.train import auroc, ce_loss, fit_mlp
+
+
+def _blobs(n=256, d=8, seed=0):
+    """Linearly separable-ish two-class data."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    x = rng.normal(0, 1, (n, d)) + y[:, None] * 2.0
+    return x.astype(np.float64), y.astype(np.int64)
+
+
+class TestFit:
+    def test_learns_separable_data(self):
+        x, y = _blobs()
+        fit = fit_mlp(lcld_mlp(), x, y, epochs=40, batch_size=64, seed=1)
+        preds = np.asarray(
+            fit.surrogate.predict_proba(jnp.asarray(x))
+        ).argmax(-1)
+        assert (preds == y).mean() > 0.9
+
+    def test_early_stopping_halts_on_plateau(self):
+        x, y = _blobs(128)
+        # validation set the model cannot improve on: random labels
+        rng = np.random.default_rng(3)
+        xv = rng.normal(0, 1, (64, x.shape[1]))
+        yv = rng.integers(0, 2, 64)
+        fit = fit_mlp(
+            lcld_mlp(), x, y, x_val=xv, y_val=yv,
+            epochs=200, batch_size=64, patience=3, seed=1,
+        )
+        # must stop long before the epoch budget
+        assert len(fit.history) < 200
+        last_epoch = fit.history[-1][0]
+        best_epoch = int(np.argmin([h[2] for h in fit.history]))
+        assert last_epoch - best_epoch >= 3  # exactly the patience window
+        # the kept parameters are the best-val ones, not the last ones
+        vl = float(
+            ce_loss(
+                fit.surrogate.model, fit.surrogate.params,
+                jnp.asarray(xv), jnp.asarray(yv),
+            )
+        )
+        np.testing.assert_allclose(vl, fit.best_val_loss, rtol=1e-6)
+
+    def test_class_weights_shift_the_decision(self):
+        """A 9:1 imbalanced problem: upweighting the minority class must
+        recover minority recall that the unweighted fit sacrifices."""
+        rng = np.random.default_rng(5)
+        n = 400
+        y = (rng.random(n) < 0.1).astype(np.int64)
+        # weakly separated: overlap forces a trade-off
+        x = rng.normal(0, 1.2, (n, 6)) + y[:, None] * 1.2
+        plain = fit_mlp(lcld_mlp(), x, y, epochs=30, batch_size=64, seed=2)
+        weighted = fit_mlp(
+            lcld_mlp(), x, y, epochs=30, batch_size=64, seed=2,
+            class_weight={0: 1.0, 1: 9.0},
+        )
+
+        def recall(fit):
+            p = np.asarray(fit.surrogate.predict_proba(jnp.asarray(x))).argmax(-1)
+            return (p[y == 1] == 1).mean()
+
+        assert recall(weighted) > recall(plain)
+
+    def test_zero_weight_padding_is_inert(self):
+        """ce_loss with weight-0 rows must equal the loss without them —
+        the padding contract the batcher relies on."""
+        x, y = _blobs(32)
+        model = lcld_mlp()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, x.shape[1])))
+        base = float(ce_loss(model, params, jnp.asarray(x), jnp.asarray(y)))
+        x_pad = jnp.asarray(np.vstack([x, np.zeros((8, x.shape[1]))]))
+        y_pad = jnp.asarray(np.concatenate([y, np.zeros(8, np.int64)]))
+        w = jnp.asarray(np.concatenate([np.ones(32), np.zeros(8)]).astype(np.float32))
+        padded = float(ce_loss(model, params, x_pad, y_pad, sample_weight=w))
+        np.testing.assert_allclose(padded, base, rtol=1e-6)
+
+    def test_uneven_batches_cover_every_sample(self):
+        # n=70 with batch_size=32 -> partial final batch; must still train
+        x, y = _blobs(70)
+        fit = fit_mlp(lcld_mlp(), x, y, epochs=25, batch_size=32, seed=4)
+        preds = np.asarray(fit.surrogate.predict_proba(jnp.asarray(x))).argmax(-1)
+        assert (preds == y).mean() > 0.85
+
+
+class TestAuroc:
+    def test_matches_quadratic_oracle(self):
+        rng = np.random.default_rng(7)
+        y = rng.integers(0, 2, 200)
+        p = np.clip(y * 0.3 + rng.random(200) * 0.8, 0, 1)
+        p = np.round(p, 2)  # force ties to exercise midranks
+
+        # O(n^2) oracle: P(score_pos > score_neg) + 0.5 P(equal)
+        pos, neg = p[y == 1], p[y == 0]
+        gt = (pos[:, None] > neg[None, :]).mean()
+        eq = (pos[:, None] == neg[None, :]).mean()
+        np.testing.assert_allclose(auroc(p, y), gt + 0.5 * eq, rtol=1e-12)
+
+    def test_degenerate_single_class(self):
+        assert np.isnan(auroc(np.linspace(0, 1, 5), np.ones(5, np.int64)))
+
+
+class TestDataParallelMesh:
+    def test_dp_fit_matches_single_device(self):
+        from jax.sharding import Mesh
+
+        x, y = _blobs(128)
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        single = fit_mlp(lcld_mlp(), x, y, epochs=8, batch_size=64, seed=6)
+        dp = fit_mlp(
+            lcld_mlp(), x, y, epochs=8, batch_size=64, seed=6, mesh=mesh
+        )
+        # same data order (seeded) + weight-0 padding => same training curve
+        np.testing.assert_allclose(
+            [h[1] for h in single.history], [h[1] for h in dp.history],
+            rtol=1e-4,
+        )
+        a = np.asarray(single.surrogate.predict_proba(jnp.asarray(x)))
+        b = np.asarray(dp.surrogate.predict_proba(jnp.asarray(x)))
+        np.testing.assert_allclose(a, b, atol=1e-4)

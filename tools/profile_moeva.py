@@ -83,11 +83,14 @@ def scan_eval_ops(pop_x, key):
     return jax.lax.scan(step, (pop_x, key), None, length=N_GEN)[0][0]
 
 
-attack = jax.jit(moeva._build_attack())
+init_fn = jax.jit(moeva._build_init())
+segment_fn = jax.jit(moeva._build_segment(), static_argnames="length")
 
 
 def full(params, x_init, mc, xl, xu, key):
-    return attack(params, x_init, mc, xl, xu, key)[0]
+    carry, _ = init_fn(params, x_init, mc, xl, xu, key)
+    carry, _ = segment_fn(params, x_init, mc, xl, xu, carry, length=N_GEN - 1)
+    return carry[0]
 
 
 timed("A eval-only      ", scan_eval, pop_x, key)

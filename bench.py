@@ -131,6 +131,7 @@ def run_real_botnet() -> dict | None:
         moeva = Moeva2(
             classifier=sur, constraints=cons, ml_scaler=scaler,
             norm=2, n_gen=n_gen, n_pop=200, n_offsprings=100, seed=42,
+            archive_size=24,  # the production default (config/moeva.yaml)
         )
         t0 = time.time()
         res = moeva.generate(x, minimize_class=1)

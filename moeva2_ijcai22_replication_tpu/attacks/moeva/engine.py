@@ -44,6 +44,8 @@ class MoevaResult:
     """Final populations for every initial state (EfficientResult parity:
     ``moeva2/result_process.py:3-16`` keeps pop X/F + the initial state)."""
 
+    #: P below = pop_size + archive_size: with an elite archive the returned
+    #: "population" is final pop columns first, then the archive columns.
     x_gen: np.ndarray  # (S, P, L) genetic populations
     f: np.ndarray  # (S, P, 3) objectives
     x_ml: np.ndarray  # (S, P, D) decoded ML-space populations
@@ -81,6 +83,15 @@ class Moeva2:
     init: str = "tile"
     init_eps: float = 0.1
     init_ratio: float = 0.5
+    #: per-state elite archive: keep the ``archive_size`` best candidates
+    #: seen across ALL generations, ranked feasible-first (Σ violations = 0)
+    #: then by misclassification probability then distance, and append them
+    #: to the returned populations. 0 (default) = reference semantics (final
+    #: population only — the reference's own pareto archive is dead code,
+    #: ``pareto_operation.py``). With an archive, success rates are monotone
+    #: in the generation budget: converged late populations can no longer
+    #: lose the constrained adversarials found mid-run.
+    archive_size: int = 0
     save_history: str | None = None
     #: generations per jitted scan segment when history is recorded; each
     #: segment's records are offloaded to host so "full" history at rq1 scale
@@ -112,6 +123,11 @@ class Moeva2:
             )
         if self.init not in ("tile", "lp_ratio"):
             raise ValueError(f"init must be 'tile' or 'lp_ratio', got {self.init!r}")
+        if not 0 <= self.archive_size <= self.pop_size:
+            raise ValueError(
+                f"archive_size={self.archive_size} must be in [0, pop_size="
+                f"{self.pop_size}] (the archive seeds from the initial population)"
+            )
         self._jit_init = None
         self._jit_segment = None
 
@@ -195,11 +211,31 @@ class Moeva2:
                 lambda k, f, st: survive(k, f, asp, st, pop_size)
             )(jax.random.split(k0, s), pop_f, norm0)
 
+            # archive seeded with the elite of the FULL initial population
+            # (lp_ratio init can already contain feasible adversarials at any
+            # row index; survival may drop them in generation 1)
+            elite = jnp.argsort(eng._archive_score(pop_f), axis=1)[
+                :, : eng.archive_size
+            ]
+            arch_x = jnp.take_along_axis(pop_x, elite[..., None], axis=1)
+            arch_f = jnp.take_along_axis(pop_f, elite[..., None], axis=1)
+
             if not eng.save_history:
                 init_hist = jnp.zeros((), eng.dtype)
-            return (pop_x, pop_f, norm_state, key), init_hist
+            return (pop_x, pop_f, arch_x, arch_f, norm_state, key), init_hist
 
         return init
+
+    @staticmethod
+    def _archive_score(f):
+        """Feasible-first elite ranking. Feasible candidates (Σ violations
+        = 0) score in [0, ~1] by misclassification prob + distance tiebreak;
+        infeasible ones score in (2, 3) by squashed violation mass — every
+        term stays O(1) so the ordering survives float32 (a 1e9-offset
+        construction would absorb all other terms at f32 precision)."""
+        g = f[..., 2]
+        feasible_score = f[..., 0] + 1e-3 * f[..., 1]
+        return jnp.where(g > 0, 2.0 + g / (1.0 + g), feasible_score)
 
     def _build_segment(self):
         codec = self.codec
@@ -215,7 +251,7 @@ class Moeva2:
             x_init_mm = codec_lib.minmax_normalize(x_init_ml, xl_ml, xu_ml)
 
             def gen_step(carry, _):
-                pop_x, pop_f, norm_state, key = carry
+                pop_x, pop_f, arch_x, arch_f, norm_state, key = carry
                 key, k_mate, k_surv = jax.random.split(key, 3)
 
                 off = jax.vmap(
@@ -246,8 +282,19 @@ class Moeva2:
                 pop_x = jnp.take_along_axis(merged_x, order[..., None], axis=1)
                 pop_f = jnp.take_along_axis(merged_f, order[..., None], axis=1)
 
+                if eng.archive_size:
+                    # elite archive update: top-A by feasible-first score over
+                    # archive ∪ offspring (monotone across generations)
+                    cand_x = jnp.concatenate([arch_x, off], axis=1)
+                    cand_f = jnp.concatenate([arch_f, off_f], axis=1)
+                    elite = jnp.argsort(eng._archive_score(cand_f), axis=1)[
+                        :, : eng.archive_size
+                    ]
+                    arch_x = jnp.take_along_axis(cand_x, elite[..., None], axis=1)
+                    arch_f = jnp.take_along_axis(cand_f, elite[..., None], axis=1)
+
                 hist = off_hist if eng.save_history else jnp.zeros((), eng.dtype)
-                return (pop_x, pop_f, norm_state, key), hist
+                return (pop_x, pop_f, arch_x, arch_f, norm_state, key), hist
 
             return jax.lax.scan(gen_step, carry, None, length=length)
 
@@ -323,7 +370,11 @@ class Moeva2:
             done += length
         if pending is not None:
             hist_chunks.append(np.asarray(jax.device_get(pending)))
-        pop_x, pop_f, _, _ = carry
+        pop_x, pop_f, arch_x, arch_f, _, _ = carry
+        if self.archive_size:
+            # archive members join the returned populations (extra columns)
+            pop_x = jnp.concatenate([pop_x, arch_x], axis=1)
+            pop_f = jnp.concatenate([pop_f, arch_f], axis=1)
         pop_x, pop_f = jax.device_get((pop_x, pop_f))
         elapsed = time.time() - t0
 

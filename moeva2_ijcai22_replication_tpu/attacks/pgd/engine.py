@@ -156,14 +156,22 @@ class ConstrainedPGD:
             return self.eps * 10.0 ** (-power)
         return self.eps_step
 
-    def _hist_columns(self) -> int:
-        """History column count: [loss, loss_class, cons_sum] (+ grad_norm
-        under ``record_grad_norm``) + per-constraint violations for "full"
-        (``classifier.py:276-296``)."""
+    def hist_column_names(self) -> list[str]:
+        """Recorded-history column layout, the single source of truth for
+        consumers (runners/streaming): [loss, loss_class, cons_sum]
+        (+ grad_norm under ``record_grad_norm``) + per-constraint violations
+        for "full" (``classifier.py:276-296``)."""
         if not self.record_loss:
-            return 0
-        k = self.constraints.n_constraints if "full" in self.record_loss else 0
-        return 3 + int(self.record_grad_norm) + k
+            return []
+        names = ["loss", "loss_class", "cons_sum"]
+        if self.record_grad_norm:
+            names.append("grad_norm")
+        if "full" in self.record_loss:
+            names += [f"g{i + 1}" for i in range(self.constraints.n_constraints)]
+        return names
+
+    def _hist_columns(self) -> int:
+        return len(self.hist_column_names())
 
     def _hist_init(self, n, dtype):
         if self.record_loss:

@@ -21,6 +21,7 @@ from ..domains import augmentation
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file, save_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
+from ..utils.streaming import stream_for
 from . import common
 
 
@@ -61,6 +62,7 @@ def run(config: dict):
         init=config.get("init", "tile"),
         init_eps=config.get("init_eps", 0.1),
         init_ratio=config.get("init_ratio", 0.5),
+        archive_size=config.get("archive_size", 0),
         save_history=config.get("save_history") or None,
         mesh=common.build_mesh(config),
     )
@@ -116,6 +118,14 @@ def run(config: dict):
         "config": config,
         "config_hash": config_hash,
     }
+    # Comet-equivalent event stream (src/utils/comet.py parity; off by
+    # default, enabled by config `streaming`).
+    with stream_for(config, mid_fix, config_hash) as stream:
+        stream.log_parameters(config)
+        stream.log_metric("time", consumed_time)
+        for eps, objectives in zip(config["eps_list"], objective_lists):
+            for k, v in objectives.items():
+                stream.log_metric(f"eps{eps}_{k}", v)
     json_to_file(metrics, metrics_path)
     save_config(config, f"{out_dir}/config_{mid_fix}_")
     return metrics

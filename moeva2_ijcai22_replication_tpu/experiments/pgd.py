@@ -22,6 +22,7 @@ from ..domains import augmentation
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
+from ..utils.streaming import stream_for
 from . import common
 
 
@@ -149,6 +150,22 @@ def run(config: dict):
         "config": config,
         "config_hash": config_hash,
     }
+    # Comet-equivalent event stream: run params, final rates, and (when loss
+    # history was recorded) the per-iteration loss/grad-norm curves the
+    # reference pushed to Comet from inside the loop
+    # (pgd/classifier.py:183-217, atk.py:201-226).
+    with stream_for(config, mid_fix, config_hash) as stream:
+        stream.log_parameters(config)
+        stream.log_metric("time", consumed_time)
+        for k, v in metrics["objectives"].items():
+            stream.log_metric(k, v)
+        if attack.loss_history is not None:
+            mean_curves = attack.loss_history.mean(axis=0)  # (max_iter, C)
+            names = attack.hist_column_names()
+            scalar = {"loss", "loss_class", "cons_sum", "grad_norm"}
+            for j, name in enumerate(names):
+                if name in scalar:  # skip the per-constraint g1..gK columns
+                    stream.log_series(f"mean_{name}", mean_curves[:, j])
     success_rate_df.to_csv(
         f"{out_dir}/success_rate_{mid_fix}_{config_hash}.csv", index=False
     )

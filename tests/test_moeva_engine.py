@@ -258,3 +258,66 @@ class TestHistoryChunking:
             np.testing.assert_allclose(a, b)
         np.testing.assert_allclose(small.x_gen, big.x_gen)
         np.testing.assert_allclose(small.f, big.f)
+
+
+class TestEliteArchive:
+    def test_archive_appends_columns_and_is_monotone(
+        self, lcld_constraints, surrogate
+    ):
+        """With archive_size, the result gains archive columns whose best
+        feasible-first score can only improve with budget (the guarantee the
+        reference's dead pareto-archive code was meant to give)."""
+        x = synth_lcld(3, lcld_constraints.schema, seed=7)
+
+        def run(n_gen):
+            moeva = Moeva2(
+                classifier=surrogate,
+                constraints=lcld_constraints,
+                ml_scaler=_scaler_for(x),
+                norm=2,
+                n_gen=n_gen,
+                n_pop=16,
+                n_offsprings=8,
+                seed=2,
+                dtype=jnp.float64,
+                archive_size=6,
+            )
+            return moeva, moeva.generate(x, minimize_class=1)
+
+        moeva, short = run(3)
+        _, long = run(9)
+        assert short.x_gen.shape[1] == moeva.pop_size + 6
+        assert short.f.shape[1] == moeva.pop_size + 6
+        assert short.x_ml.shape[1] == moeva.pop_size + 6
+
+        def best_score(res):
+            f = res.f[:, -6:, :]
+            score = np.where(f[..., 2] > 0, 1e9 + f[..., 2], 0.0) + f[..., 0]
+            return score.min(axis=1)
+
+        assert (best_score(long) <= best_score(short) + 1e-9).all()
+
+    def test_archive_members_track_population_history(
+        self, lcld_constraints, surrogate
+    ):
+        """Archive rows are real evaluated candidates: re-evaluating their
+        ML decode must reproduce the stored objectives."""
+        x = synth_lcld(2, lcld_constraints.schema, seed=8)
+        moeva = Moeva2(
+            classifier=surrogate,
+            constraints=lcld_constraints,
+            ml_scaler=_scaler_for(x),
+            norm=2,
+            n_gen=5,
+            n_pop=12,
+            n_offsprings=6,
+            seed=3,
+            dtype=jnp.float64,
+            archive_size=4,
+        )
+        res = moeva.generate(x, minimize_class=1)
+        arch_ml = res.x_ml[:, -4:, :]
+        g = np.asarray(lcld_constraints.evaluate(jnp.asarray(arch_ml)))
+        np.testing.assert_allclose(
+            g.sum(-1), res.f[:, -4:, 2], atol=1e-8
+        )

@@ -1,0 +1,132 @@
+"""LCLD raw-data preprocessing tests on a synthetic raw LendingClub sample."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from moeva2_ijcai22_replication_tpu.experiments.preprocess import (
+    preprocess_lcld,
+    _schema_order,
+)
+
+
+def raw_sample(n=40, seed=0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    status = rng.choice(["Fully Paid", "Charged Off", "Current"], n)
+    term = rng.choice([" 36 months", " 60 months"], n)
+    rate = rng.uniform(5.5, 30.0, n).round(2)
+    loan = rng.integers(1000, 40000, n).astype(float)
+    r = rate / 1200.0
+    t = np.where(np.char.find(term.astype(str), "36") >= 0, 36, 60)
+    inst = loan * r * (1 + r) ** t / ((1 + r) ** t - 1)
+    issue_month = rng.integers(1, 13, n)
+    cr_year = rng.integers(1990, 2012, n)
+    df = pd.DataFrame(
+        {
+            "id": np.arange(n),
+            "loan_status": status,
+            "term": term,
+            "int_rate": rate,
+            "loan_amnt": loan,
+            "installment": inst.round(2),
+            "grade": rng.choice(list("ABCDEFG"), n),
+            "sub_grade": rng.choice(["A1", "B2"], n),
+            "emp_title": "x",
+            "emp_length": rng.choice(
+                ["10+ years", "< 1 year", "5 years", None], n
+            ),
+            "home_ownership": rng.choice(
+                ["MORTGAGE", "RENT", "OWN", "NONE", "ANY"], n
+            ),
+            "annual_inc": rng.uniform(2e4, 2e5, n).round(0),
+            "verification_status": rng.choice(
+                ["Not Verified", "Source Verified", "Verified"], n
+            ),
+            "issue_d": [f"2015-{m:02d}-01" for m in issue_month],
+            "purpose": rng.choice(["car", "credit_card", "wedding"], n),
+            "title": "y",
+            "zip_code": "123xx",
+            "addr_state": "CA",
+            "dti": rng.uniform(0, 40, n).round(2),
+            "earliest_cr_line": [f"{y}-06-01" for y in cr_year],
+            "fico_range_low": rng.integers(620, 800, n).astype(float),
+            "fico_range_high": rng.integers(620, 800, n).astype(float) + 4,
+            # real exports satisfy open_acc <= total_acc and
+            # pub_rec_bankruptcies <= pub_rec by construction
+            "open_acc": (open_acc := rng.integers(1, 20, n).astype(float)),
+            "pub_rec": (pub_rec := rng.integers(0, 3, n).astype(float)),
+            "revol_bal": rng.uniform(0, 5e4, n).round(0),
+            "revol_util": rng.uniform(0, 120, n).round(1),
+            "total_acc": open_acc + rng.integers(0, 40, n).astype(float),
+            "initial_list_status": rng.choice(["w", "f"], n),
+            "application_type": rng.choice(["Individual", "Joint App"], n),
+            "mort_acc": rng.integers(0, 5, n).astype(float),
+            "pub_rec_bankruptcies": np.minimum(
+                rng.integers(0, 2, n).astype(float), pub_rec
+            ),
+        }
+    )
+    return df
+
+
+@pytest.fixture(scope="module")
+def processed():
+    raw = raw_sample()
+    return raw, preprocess_lcld(raw)
+
+
+class TestPreprocess:
+    def test_columns_match_committed_schema(self, processed, lcld_paths):
+        """Output columns == the reference's features.csv, in order, plus
+        the target — the contract the whole artifact family builds on."""
+        _, out = processed
+        schema_names = pd.read_csv(lcld_paths["features"])["feature"].tolist()
+        assert out.columns.tolist() == schema_names + ["charged_off"]
+        assert _schema_order() == schema_names
+
+    def test_status_filter_and_target(self, processed):
+        raw, out = processed
+        kept = raw["loan_status"].isin(["Fully Paid", "Charged Off"])
+        assert len(out) <= kept.sum()  # dropna may remove more
+        assert set(out["charged_off"].unique()) <= {0, 1}
+
+    def test_scalar_encodings(self, processed):
+        _, out = processed
+        assert set(out["term"].unique()) <= {36, 60}
+        assert out["grade"].between(1, 7).all()
+        assert out["emp_length"].between(0, 10).all()
+        # YYYYMM ints
+        assert (out["issue_d"] // 100 == 2015).all()
+        assert out["earliest_cr_line"].mod(100).between(1, 12).all()
+
+    def test_preprocessed_rows_satisfy_lcld_constraints(self, processed, lcld_paths):
+        """The derived features ARE the constraint right-hand sides, so a
+        preprocessed row must satisfy all 10 LCLD formulas — the domain
+        plugin is the oracle (same cross-check the reference performs by
+        running check_constraints_error on its candidate sets)."""
+        from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+
+        _, out = processed
+        cons = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+        x = out.drop(columns="charged_off").to_numpy(dtype=float)
+        g = np.asarray(cons.evaluate(x))
+        assert g.max() <= 1e-9, g.max(0)
+
+    def test_one_hot_exactness(self, processed):
+        raw, out = processed
+        ohe = [c for c in out.columns if c.startswith(("home_ownership_",
+                                                       "verification_status_",
+                                                       "purpose_"))]
+        groups = ("home_ownership", "verification_status", "purpose")
+        for g in groups:
+            cols = [c for c in ohe if c.startswith(g)]
+            np.testing.assert_array_equal(out[cols].sum(axis=1), 1)
+
+    def test_pinned_levels_survive_missing_categories(self):
+        """A raw sample that lacks a category must still produce the full
+        schema width (the reference's get_dummies would silently narrow)."""
+        raw = raw_sample(30, seed=3)
+        raw["purpose"] = "car"  # single level only
+        out = preprocess_lcld(raw)
+        assert "purpose_wedding" in out.columns
+        assert (out["purpose_wedding"] == 0).all()

@@ -256,3 +256,38 @@ class TestGridFailureIsolation:
         names = os.listdir(out_dir)
         # the good point produced metrics even though the poisoned one failed
         assert sum(s.startswith("metrics_moeva_") for s in names) == 1
+
+
+class TestStreaming:
+    def test_pgd_runner_streams_events(self, artifacts, tmp_path):
+        from moeva2_ijcai22_replication_tpu.utils.streaming import read_events
+
+        cfg = base_config(
+            artifacts, tmp_path / "out",
+            attack_name="pgd", budget=4,
+            save_history="reduced",
+        )
+        cfg["eps"] = 0.2
+        cfg["loss_evaluation"] = "constraints+flip"
+        cfg["streaming"] = True
+        cfg["save_grad_norm"] = True
+        metrics = pgd_runner.run(cfg)
+        h = metrics["config_hash"]
+        evs = list(
+            read_events(tmp_path / "out" / f"events_pgd_constraints+flip_{h}.jsonl")
+        )
+        names = {e.get("name") for e in evs if e["event"] == "metric"}
+        # final rates + the streamed per-iteration curves incl. grad norms
+        assert {"o7", "time", "mean_loss", "mean_grad_norm"} <= names
+        curve = [e for e in evs if e.get("name") == "mean_loss"]
+        assert len(curve) == 4  # one event per iteration
+
+    def test_moeva_runner_streams_events(self, artifacts, tmp_path):
+        from moeva2_ijcai22_replication_tpu.utils.streaming import read_events
+
+        cfg = base_config(artifacts, tmp_path / "out", streaming=True)
+        metrics = moeva_runner.run(cfg)
+        h = metrics["config_hash"]
+        evs = list(read_events(tmp_path / "out" / f"events_moeva_{h}.jsonl"))
+        names = {e.get("name") for e in evs if e["event"] == "metric"}
+        assert "eps0.5_o7" in names and "time" in names

@@ -82,3 +82,32 @@ class TestMetricsRecords:
         recs = list(records(str(tmp_path)))
         assert len(recs) == 3
         assert {r["attack_name"] for r in recs} == {"moeva", "constraints+flip"}
+
+
+class TestExperimentStream:
+    def test_events_roundtrip(self, tmp_path):
+        from moeva2_ijcai22_replication_tpu.utils.streaming import (
+            ExperimentStream,
+            read_events,
+        )
+
+        p = str(tmp_path / "ev.jsonl")
+        with ExperimentStream(p, name="demo") as s:
+            s.log_parameters({"budget": 3, "arr": np.array([1, 2])})
+            s.log_metric("o7", 0.5)
+            s.log_series("loss", np.array([3.0, 2.0, 1.0]))
+        evs = list(read_events(p))
+        kinds = [e["event"] for e in evs]
+        assert kinds[0] == "start" and kinds[-1] == "end"
+        assert kinds.count("metric") == 4
+        steps = [e["step"] for e in evs if e.get("name") == "loss"]
+        assert steps == [0, 1, 2]
+        assert all("t" in e for e in evs)
+
+    def test_disabled_stream_writes_nothing(self, tmp_path):
+        from moeva2_ijcai22_replication_tpu.utils.streaming import ExperimentStream
+
+        p = str(tmp_path / "off.jsonl")
+        with ExperimentStream(p, enabled=False) as s:
+            s.log_metric("x", 1)
+        assert not (tmp_path / "off.jsonl").exists()

@@ -36,7 +36,7 @@ from ...models.scalers import MinMaxParams
 from .initialisation import lp_ratio_init, tile_init
 from .operators import OperatorTables, make_operator_tables, make_offspring
 from .refdirs import energy_ref_dirs, rnsga3_geometry
-from .survival import NormState, survive
+from .survival import NormState, survive_batch
 
 
 @dataclass
@@ -130,6 +130,11 @@ class Moeva2:
             )
         self._jit_init = None
         self._jit_segment = None
+        # Pallas-fused niche association on single-device TPU; XLA einsum
+        # path elsewhere (decided at trace time — the backend is fixed per
+        # process). Under a mesh the XLA path is used: a pallas_call does not
+        # auto-partition inside pjit (it would need a shard_map wrapper).
+        self._use_pallas = jax.default_backend() == "tpu" and self.mesh is None
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
@@ -207,18 +212,15 @@ class Moeva2:
             # Initialisation survival: everyone survives, normalisation state
             # (ideal/worst/extreme) warms up — pymoo GeneticAlgorithm._initialize.
             norm0 = jax.vmap(lambda _: NormState.init(3, eng.dtype))(jnp.arange(s))
-            _, norm_state, _ = jax.vmap(
-                lambda k, f, st: survive(k, f, asp, st, pop_size)
-            )(jax.random.split(k0, s), pop_f, norm0)
+            _, norm_state, _ = survive_batch(
+                jax.random.split(k0, s), pop_f, asp, norm0, pop_size,
+                use_pallas=eng._use_pallas,
+            )
 
             # archive seeded with the elite of the FULL initial population
             # (lp_ratio init can already contain feasible adversarials at any
             # row index; survival may drop them in generation 1)
-            elite = jnp.argsort(eng._archive_score(pop_f), axis=1)[
-                :, : eng.archive_size
-            ]
-            arch_x = jnp.take_along_axis(pop_x, elite[..., None], axis=1)
-            arch_f = jnp.take_along_axis(pop_f, elite[..., None], axis=1)
+            arch_x, arch_f = eng._archive_select(pop_x, pop_f)
 
             if not eng.save_history:
                 init_hist = jnp.zeros((), eng.dtype)
@@ -236,6 +238,17 @@ class Moeva2:
         g = f[..., 2]
         feasible_score = f[..., 0] + 1e-3 * f[..., 1]
         return jnp.where(g > 0, 2.0 + g / (1.0 + g), feasible_score)
+
+    def _archive_select(self, cand_x, cand_f):
+        """Top-``archive_size`` candidates by feasible-first score — the one
+        elite-selection rule, shared by the seeding and per-generation update."""
+        elite = jnp.argsort(self._archive_score(cand_f), axis=1)[
+            :, : self.archive_size
+        ]
+        return (
+            jnp.take_along_axis(cand_x, elite[..., None], axis=1),
+            jnp.take_along_axis(cand_f, elite[..., None], axis=1),
+        )
 
     def _build_segment(self):
         codec = self.codec
@@ -273,25 +286,37 @@ class Moeva2:
                 merged_x = jnp.concatenate([pop_x, off], axis=1)
                 merged_f = jnp.concatenate([pop_f, off_f], axis=1)
 
-                mask, norm_state, _ = jax.vmap(
-                    lambda k, f, st: survive(k, f, asp, st, pop_size)
-                )(jax.random.split(k_surv, s), merged_f, norm_state)
+                mask, norm_state, _ = survive_batch(
+                    jax.random.split(k_surv, s), merged_f, asp, norm_state,
+                    pop_size, use_pallas=eng._use_pallas,
+                )
 
-                # Dense survivor extraction: stable order, survivors first.
-                order = jnp.argsort(~mask, axis=1, stable=True)[:, :pop_size]
+                # Dense survivor extraction, stable order survivors-first:
+                # the permutation comes from two cumsums + a scatter (a
+                # stable bool argsort costs a full sort per state per
+                # generation on TPU; this is linear).
+                m_tot = mask.shape[1]
+                n_true = mask.sum(1, keepdims=True)
+                dest = jnp.where(
+                    mask,
+                    jnp.cumsum(mask, axis=1) - 1,
+                    n_true + jnp.cumsum(~mask, axis=1) - 1,
+                )
+                order = (
+                    jnp.zeros_like(dest)
+                    .at[jnp.arange(dest.shape[0])[:, None], dest]
+                    .set(jnp.broadcast_to(jnp.arange(m_tot), dest.shape))
+                )[:, :pop_size]
                 pop_x = jnp.take_along_axis(merged_x, order[..., None], axis=1)
                 pop_f = jnp.take_along_axis(merged_f, order[..., None], axis=1)
 
                 if eng.archive_size:
                     # elite archive update: top-A by feasible-first score over
                     # archive ∪ offspring (monotone across generations)
-                    cand_x = jnp.concatenate([arch_x, off], axis=1)
-                    cand_f = jnp.concatenate([arch_f, off_f], axis=1)
-                    elite = jnp.argsort(eng._archive_score(cand_f), axis=1)[
-                        :, : eng.archive_size
-                    ]
-                    arch_x = jnp.take_along_axis(cand_x, elite[..., None], axis=1)
-                    arch_f = jnp.take_along_axis(cand_f, elite[..., None], axis=1)
+                    arch_x, arch_f = eng._archive_select(
+                        jnp.concatenate([arch_x, off], axis=1),
+                        jnp.concatenate([arch_f, off_f], axis=1),
+                    )
 
                 hist = off_hist if eng.save_history else jnp.zeros((), eng.dtype)
                 return (pop_x, pop_f, arch_x, arch_f, norm_state, key), hist

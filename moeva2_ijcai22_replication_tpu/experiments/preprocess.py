@@ -60,8 +60,14 @@ def _months(d: pd.Series) -> pd.Series:
 def preprocess_lcld(raw: pd.DataFrame) -> pd.DataFrame:
     """Raw LendingClub frame → cleaned frame: the 47 schema features (in
     ``features.csv`` order) + the ``charged_off`` target."""
+    missing_raw = [c for c in KEEP + ["loan_status"] if c not in raw.columns]
+    if missing_raw:
+        raise ValueError(
+            f"raw export is missing required columns: {missing_raw} — the "
+            "47-feature schema cannot be derived from this file"
+        )
     loans = raw.loc[raw["loan_status"].isin(["Fully Paid", "Charged Off"])]
-    loans = loans[[c for c in KEEP if c in loans.columns]].copy()
+    loans = loans[KEEP].copy()
 
     # scalar encodings
     loans["term"] = loans["term"].map(lambda s: int(str(s).split()[0]))

@@ -296,3 +296,54 @@ class TestReviewRegressions:
         assert (~real_crossed & int_crossed).any()
         assert (real_crossed & int_crossed).any()
         assert (~real_crossed & ~int_crossed).any()
+
+
+class TestPallasAssociation:
+    def test_pallas_kernel_matches_xla_path(self):
+        """The fused association kernel (interpret mode on CPU) must agree
+        with the XLA einsum path: identical niches, close distances."""
+        import jax
+        import jax.numpy as jnp
+
+        from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import (
+            associate_batch,
+        )
+
+        key = jax.random.PRNGKey(0)
+        f = jax.random.uniform(key, (5, 37, 3), jnp.float32)
+        dirs = jax.random.uniform(jax.random.PRNGKey(1), (5, 19, 3), jnp.float32) + 0.1
+        ideal = f.min(axis=1) - 0.05
+        nadir = f.max(axis=1) + 0.05
+
+        n_x, d_x = associate_batch(f, dirs, ideal, nadir, use_pallas=False)
+        n_p, d_p = associate_batch(
+            f, dirs, ideal, nadir, use_pallas=True, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_x))
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x), atol=1e-5)
+
+    def test_survive_batch_matches_vmapped_survive(self):
+        import jax
+        import jax.numpy as jnp
+
+        from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import (
+            NormState,
+            survive,
+            survive_batch,
+        )
+
+        key = jax.random.PRNGKey(3)
+        S, M, NS = 4, 31, 13
+        f = jax.random.uniform(key, (S, M, 3), jnp.float64)
+        asp = jax.random.uniform(jax.random.PRNGKey(4), (11, 3), jnp.float64)
+        st = jax.vmap(lambda _: NormState.init(3, jnp.float64))(jnp.arange(S))
+        keys = jax.random.split(jax.random.PRNGKey(5), S)
+
+        m_v, st_v, r_v = jax.vmap(
+            lambda k, f1, s1: survive(k, f1, asp, s1, NS)
+        )(keys, f, st)
+        m_b, st_b, r_b = survive_batch(keys, f, asp, st, NS)
+        np.testing.assert_array_equal(np.asarray(m_b), np.asarray(m_v))
+        np.testing.assert_array_equal(np.asarray(r_b), np.asarray(r_v))
+        for a, b in zip(st_b, st_v):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))

@@ -130,3 +130,8 @@ class TestPreprocess:
         out = preprocess_lcld(raw)
         assert "purpose_wedding" in out.columns
         assert (out["purpose_wedding"] == 0).all()
+
+    def test_missing_raw_column_raises_cleanly(self):
+        raw = raw_sample(20, seed=1).drop(columns=["application_type"])
+        with pytest.raises(ValueError, match="application_type"):
+            preprocess_lcld(raw)

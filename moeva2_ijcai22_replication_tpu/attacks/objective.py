@@ -16,6 +16,14 @@ Reference parity (``/root/reference/src/attacks/moeva2/objective_calculator.py``
 TPU-first: the whole (states x population) tensor is evaluated as one jitted
 program with a single device→host reduction, instead of the reference's
 per-state Python loop over joblib threads.
+
+Precision: success judgement runs in float64 on the host CPU backend
+(``precise=True``, the default). The reference evaluates with numpy float64;
+at botnet scale the global sum-equality constraints add ~90 features of
+magnitude up to ~6e9, where one float32 ulp is 512 — an accelerator f32
+evaluation flags exact (f64-verified) MILP repairs as violating by exactly
+that ulp. The attack hot loops stay f32 on device; only this post-hoc metric
+needs oracle precision.
 """
 
 from __future__ import annotations
@@ -46,6 +54,9 @@ class ObjectiveCalculator:
     minimize_class: int = 1
     norm: Any = np.inf
     ml_scaler: MinMaxParams | None = None
+    #: evaluate in float64 on the host CPU backend (reference = numpy f64);
+    #: False keeps the session's default device/precision.
+    precise: bool = True
 
     def __post_init__(self):
         validate_norm(self.norm)
@@ -76,9 +87,26 @@ class ObjectiveCalculator:
     def objectives(self, x_initial: np.ndarray, x_f: np.ndarray) -> np.ndarray:
         """[cv, f1, f2] per candidate; scaling-range asserts mirror
         ``objective_calculator.py:72-76``."""
-        vals, (lo, hi) = self._jit_objectives(
-            self.classifier.params, jnp.asarray(x_initial), jnp.asarray(x_f)
-        )
+        if self.precise:
+            import contextlib
+
+            with contextlib.ExitStack() as stack:
+                stack.enter_context(jax.enable_x64(True))
+                try:
+                    stack.enter_context(jax.default_device(jax.devices("cpu")[0]))
+                except RuntimeError:
+                    pass  # no CPU backend registered: keep the default device
+                vals, (lo, hi) = self._jit_objectives(
+                    jax.tree.map(
+                        lambda a: np.asarray(a, np.float64), self.classifier.params
+                    ),
+                    np.asarray(x_initial, np.float64),
+                    np.asarray(x_f, np.float64),
+                )
+        else:
+            vals, (lo, hi) = self._jit_objectives(
+                self.classifier.params, jnp.asarray(x_initial), jnp.asarray(x_f)
+            )
         tol = 1e-4
         if not (float(lo) >= -tol and float(hi) <= 1 + tol):
             raise AssertionError(
@@ -101,15 +129,24 @@ class ObjectiveCalculator:
     def at_least_one(self, x_initial, x_f) -> np.ndarray:
         return self.success_rate(x_initial, x_f) > 0
 
-    def success_rate_3d(self, x_initial: np.ndarray, x: np.ndarray) -> np.ndarray:
-        """(7,) fraction of states with ≥1 qualifying candidate (``:106-119``)."""
-        o = self.respected(self.objectives(np.asarray(x_initial), np.asarray(x)))
+    def success_rate_3d(
+        self, x_initial: np.ndarray, x: np.ndarray, objective_values=None
+    ) -> np.ndarray:
+        """(7,) fraction of states with ≥1 qualifying candidate (``:106-119``).
+
+        ``objective_values`` reuses a prior :meth:`objectives` result —
+        thresholds only enter :meth:`respected`, so ε sweeps over the same
+        candidates need the expensive evaluation once.
+        """
+        if objective_values is None:
+            objective_values = self.objectives(np.asarray(x_initial), np.asarray(x))
+        o = self.respected(objective_values)
         return o.any(axis=1).mean(axis=0)
 
-    def success_rate_3d_df(self, x_initial, x):
+    def success_rate_3d_df(self, x_initial, x, objective_values=None):
         import pandas as pd
 
-        rates = self.success_rate_3d(x_initial, x)
+        rates = self.success_rate_3d(x_initial, x, objective_values)
         return pd.DataFrame(rates.reshape(1, -1), columns=list(O_COLUMNS))
 
     # -- successful-attack extraction ---------------------------------------

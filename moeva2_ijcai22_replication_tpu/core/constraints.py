@@ -53,9 +53,11 @@ class ConstraintSet:
                     f"but the constraint-bounds file has {bounds.n_constraints} rows "
                     "(base vs augmented constraints.csv mix-up?)"
                 )
-            rng = np.asarray(bounds.cmax) - np.asarray(bounds.cmin)
-            self._norm_cmin = jnp.asarray(bounds.cmin)
-            self._norm_inv_rng = jnp.asarray(1.0 / np.where(rng == 0, 1.0, rng))
+            # numpy f64 constants: exact under the f64 post-hoc evaluator,
+            # converted per the active x64 mode when traced
+            rng = np.asarray(bounds.cmax, np.float64) - np.asarray(bounds.cmin, np.float64)
+            self._norm_cmin = np.asarray(bounds.cmin, np.float64)
+            self._norm_inv_rng = 1.0 / np.where(rng == 0, 1.0, rng)
 
     # -- to implement ------------------------------------------------------
     def _raw(self, x: jnp.ndarray) -> jnp.ndarray:

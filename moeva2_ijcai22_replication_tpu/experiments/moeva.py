@@ -94,21 +94,24 @@ def run(config: dict):
     # ----- Success rates per ε (04_moeva.py:112-131)
     with timer.phase("evaluate"):
         eval_constraints = common.evaluation_constraints(config, constraints)
+        calc = ObjectiveCalculator(
+            classifier=surrogate,
+            constraints=eval_constraints,
+            thresholds={"f1": config["misclassification_threshold"], "f2": 0.0},
+            min_max_scaler=scaler,
+            ml_scaler=scaler,
+            minimize_class=1,
+            norm=config["norm"],
+        )
+        # [cv, f1, f2] is ε-independent: evaluate once, re-threshold per ε
+        vals = calc.objectives(x_initial_states, x_attacks)
         objective_lists = []
         for eps in config["eps_list"]:
-            calc = ObjectiveCalculator(
-                classifier=surrogate,
-                constraints=eval_constraints,
-                thresholds={
-                    "f1": config["misclassification_threshold"],
-                    "f2": eps,
-                },
-                min_max_scaler=scaler,
-                ml_scaler=scaler,
-                minimize_class=1,
-                norm=config["norm"],
-            )
-            df = calc.success_rate_3d_df(x_initial_states, x_attacks)
+            calc.thresholds = {
+                "f1": config["misclassification_threshold"],
+                "f2": eps,
+            }
+            df = calc.success_rate_3d_df(x_initial_states, x_attacks, vals)
             objective_lists.append(df.to_dict(orient="records")[0])
 
     metrics = {

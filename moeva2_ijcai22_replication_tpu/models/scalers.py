@@ -16,8 +16,12 @@ import numpy as np
 
 
 class MinMaxParams(NamedTuple):
-    scale: jnp.ndarray  # multiply
-    min_: jnp.ndarray  # then add  (sklearn's X * scale_ + min_)
+    """Constants are kept as numpy float64 and embedded at trace time, so the
+    same scaler is exact under the f64 post-hoc evaluator and compact f32
+    inside device attack loops (conversion follows the active x64 mode)."""
+
+    scale: np.ndarray  # multiply
+    min_: np.ndarray  # then add  (sklearn's X * scale_ + min_)
 
     def transform(self, x: jnp.ndarray) -> jnp.ndarray:
         return x * self.scale + self.min_
@@ -28,17 +32,17 @@ class MinMaxParams(NamedTuple):
 
 def fit_minmax(x_min: np.ndarray, x_max: np.ndarray) -> MinMaxParams:
     """Fit to explicit per-feature bounds (sklearn zero-range semantics)."""
-    rng = np.asarray(x_max, dtype=float) - np.asarray(x_min, dtype=float)
+    rng = np.asarray(x_max, dtype=np.float64) - np.asarray(x_min, dtype=np.float64)
     scale = 1.0 / np.where(rng == 0, 1.0, rng)
     return MinMaxParams(
-        scale=jnp.asarray(scale), min_=jnp.asarray(-np.asarray(x_min) * scale)
+        scale=scale, min_=-np.asarray(x_min, dtype=np.float64) * scale
     )
 
 
 def from_sklearn_minmax(scaler) -> MinMaxParams:
     return MinMaxParams(
-        scale=jnp.asarray(np.asarray(scaler.scale_)),
-        min_=jnp.asarray(np.asarray(scaler.min_)),
+        scale=np.asarray(scaler.scale_, dtype=np.float64),
+        min_=np.asarray(scaler.min_, dtype=np.float64),
     )
 
 

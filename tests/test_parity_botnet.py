@@ -17,10 +17,13 @@ as fixtures that CI re-derives:
   against its pinned rates.
 
 Full-scale numbers for the record (budget 1000): MoEvA o1..o7 =
-[1, 1, 1, .0749, 1, 1, .0749] (f64 re-evaluation; the on-TPU f32 evaluation
-reports .072 — two boundary states); PGD(flip) flips every state but
+[1, 1, 1, .0749, 1, 1, .0749] without an archive and .969 with the
+production ``archive_size: 24`` default; PGD(flip) flips every state but
 satisfies constraints nowhere (o2=1, o1=o7=0); PGD(constraints+flip) stops
-flipping (o2=0) — the reference paper's qualitative botnet story.
+flipping (o2=0); PGD(flip)+SAT repairs every flip exactly (o7=1.0) — the
+reference paper's qualitative botnet story end to end. All success rates
+are f64 judgements (``ObjectiveCalculator(precise=True)``): botnet sum
+equalities run at magnitudes (~6e9) beyond f32 ulp resolution.
 """
 
 import json

@@ -42,9 +42,13 @@ LCLD_DIR = "/root/reference/data/lcld"
 MODEL = "/root/reference/models/lcld/nn.model"
 SCALER = "/root/reference/models/lcld/scaler.joblib"
 
-# Fallback per-(generation x state) reference CPU cost [s], measured on the
-# dev host (TF SavedModel forward on (100, 47): 0.69 ms + numpy constraints
-# 0.06 ms) — used only if TF cannot run on the bench host.
+# Per-(generation x state) reference CPU cost [s] calibrated on an idle dev
+# host (TF SavedModel forward on (100, 47): 0.69 ms + numpy constraints
+# 0.06 ms). Used as the fallback when TF cannot run, and as a CAP on the
+# live measurement: a busy bench host inflates the TF timing (observed up to
+# 8x under concurrent load), which would inflate the reported speedup — the
+# denominator is clamped to the calibrated idle number so the headline can
+# only be under-, never over-stated by host noise.
 FALLBACK_REF_PERGEN_S = 7.5e-4
 
 
@@ -266,7 +270,14 @@ def main():
 
     real_botnet = run_real_botnet()
 
-    t_pergen = measure_ref_pergen()
+    t_measured = measure_ref_pergen()
+    t_pergen = min(t_measured, FALLBACK_REF_PERGEN_S)
+    if t_pergen < t_measured:
+        log(
+            f"[bench] measured ref per-gen {t_measured*1e3:.2f} ms clamped to "
+            f"the calibrated idle {FALLBACK_REF_PERGEN_S*1e3:.2f} ms "
+            "(busy host would inflate the speedup)"
+        )
     cores = os.cpu_count() or 1
     ref_s = t_pergen * N_STATES * N_GEN / cores
     log(f"[bench] ref CPU estimate: {ref_s:.1f}s (perfect {cores}-core scaling assumed)")

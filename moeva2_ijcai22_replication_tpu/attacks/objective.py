@@ -62,6 +62,7 @@ class ObjectiveCalculator:
         validate_norm(self.norm)
         self._ohe_idx, self._ohe_mask = full_ohe_tables(self.constraints.schema)
         self._jit_objectives = jax.jit(self._objectives)
+        self._params_f64 = None  # lazy f64 host copy of the classifier params
 
     # -- kernels ------------------------------------------------------------
     def _objectives(self, params, x_initial, x_f):
@@ -89,17 +90,24 @@ class ObjectiveCalculator:
         ``objective_calculator.py:72-76``."""
         if self.precise:
             import contextlib
+            import warnings
 
+            if self._params_f64 is None:
+                self._params_f64 = jax.tree.map(
+                    lambda a: np.asarray(a, np.float64), self.classifier.params
+                )
             with contextlib.ExitStack() as stack:
                 stack.enter_context(jax.enable_x64(True))
                 try:
                     stack.enter_context(jax.default_device(jax.devices("cpu")[0]))
                 except RuntimeError:
-                    pass  # no CPU backend registered: keep the default device
+                    warnings.warn(
+                        "precise=True but no CPU backend is registered: the "
+                        "f64 judgement runs on the default accelerator, which "
+                        "may not support native float64"
+                    )
                 vals, (lo, hi) = self._jit_objectives(
-                    jax.tree.map(
-                        lambda a: np.asarray(a, np.float64), self.classifier.params
-                    ),
+                    self._params_f64,
                     np.asarray(x_initial, np.float64),
                     np.asarray(x_f, np.float64),
                 )

@@ -130,11 +130,10 @@ class Moeva2:
             )
         self._jit_init = None
         self._jit_segment = None
-        # Pallas-fused niche association on single-device TPU; XLA einsum
-        # path elsewhere (decided at trace time — the backend is fixed per
-        # process). Under a mesh the XLA path is used: a pallas_call does not
-        # auto-partition inside pjit (it would need a shard_map wrapper).
-        self._use_pallas = jax.default_backend() == "tpu" and self.mesh is None
+        # Pallas-fused niche association on TPU (shard_map'd over the states
+        # axis under a mesh); XLA einsum path elsewhere (decided at trace
+        # time — the backend is fixed per process).
+        self._use_pallas = jax.default_backend() == "tpu"
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
@@ -214,7 +213,8 @@ class Moeva2:
             norm0 = jax.vmap(lambda _: NormState.init(3, eng.dtype))(jnp.arange(s))
             _, norm_state, _ = survive_batch(
                 jax.random.split(k0, s), pop_f, asp, norm0, pop_size,
-                use_pallas=eng._use_pallas,
+                use_pallas=eng._use_pallas, mesh=eng.mesh,
+                states_axis=eng.states_axis,
             )
 
             # archive seeded with the elite of the FULL initial population
@@ -288,7 +288,8 @@ class Moeva2:
 
                 mask, norm_state, _ = survive_batch(
                     jax.random.split(k_surv, s), merged_f, asp, norm_state,
-                    pop_size, use_pallas=eng._use_pallas,
+                    pop_size, use_pallas=eng._use_pallas, mesh=eng.mesh,
+                    states_axis=eng.states_axis,
                 )
 
                 # Dense survivor extraction, stable order survivors-first:

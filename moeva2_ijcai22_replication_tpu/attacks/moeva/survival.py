@@ -17,6 +17,7 @@ updates (the only inherently sequential part of the algorithm).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -192,17 +193,32 @@ def _associate_pallas(n, d, interpret=False):
     return rmin[:, 0], niche[:, 0]
 
 
-def associate_batch(f, dirs, ideal, nadir, use_pallas=False, interpret=False):
+def associate_batch(
+    f, dirs, ideal, nadir, use_pallas=False, interpret=False,
+    mesh=None, states_axis="states",
+):
     """Batched niche association over the states axis: every input carries a
-    leading (S,) dim. Returns ``(niche (S, M), dist (S, M))``."""
+    leading (S,) dim. Returns ``(niche (S, M), dist (S, M))``.
+
+    With ``mesh``, the Pallas kernel is wrapped in ``jax.shard_map`` over the
+    states axis (states are independent, so no collectives) — pallas_call
+    does not auto-partition inside pjit, shard_map restores the per-device
+    grid."""
     denom = nadir - ideal
     denom = jnp.where(denom == 0, 1e-12, denom)
     n = (f - ideal[:, None, :]) / denom[:, None, :]
     d = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
     if use_pallas:
-        rmin, niche = _associate_pallas(
-            n.astype(jnp.float32), d.astype(jnp.float32), interpret=interpret
-        )
+        kernel = partial(_associate_pallas, interpret=interpret)
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(states_axis)
+            kernel = jax.shard_map(
+                kernel, mesh=mesh, in_specs=(spec, spec),
+                out_specs=(spec, spec), check_vma=False,
+            )
+        rmin, niche = kernel(n.astype(jnp.float32), d.astype(jnp.float32))
         dist = jnp.sqrt(jnp.clip(rmin, 0.0, None)).astype(f.dtype)
         return niche, dist
     proj = jnp.einsum("smk,srk->smr", n, d)
@@ -359,15 +375,19 @@ def survive_batch(
     n_survive: int,
     use_pallas: bool = False,
     interpret: bool = False,
+    mesh=None,
+    states_axis: str = "states",
 ):
     """Batched survival over the states axis — identical semantics to
     ``vmap(survive)``, with the association step lifted out of the vmap so it
-    can run as one fused Pallas program on TPU."""
+    can run as one fused Pallas program on TPU (shard_map'd over ``mesh``
+    when the states axis is device-sharded)."""
     ranks, dirs, nadir, new_state = jax.vmap(
         lambda f1, st: _survive_pre(f1, asp_points, st, n_survive)
     )(f, state)
     niche, dist = associate_batch(
-        f, dirs, new_state.ideal, nadir, use_pallas=use_pallas, interpret=interpret
+        f, dirs, new_state.ideal, nadir, use_pallas=use_pallas,
+        interpret=interpret, mesh=mesh, states_axis=states_axis,
     )
     mask = jax.vmap(
         lambda k, f1, r1, ni, di: _survive_post(

@@ -291,3 +291,27 @@ class TestStreaming:
         evs = list(read_events(tmp_path / "out" / f"events_moeva_{h}.jsonl"))
         names = {e.get("name") for e in evs if e["event"] == "metric"}
         assert "eps0.5_o7" in names and "time" in names
+
+
+class TestRunAll:
+    def test_composition_over_committed_configs(self, monkeypatch):
+        """run_all must dispatch every committed grid/rq4 YAML in the
+        reference's run_all.sh order (all 12 files must parse)."""
+        from moeva2_ijcai22_replication_tpu.experiments import run_all
+
+        calls = []
+        monkeypatch.setattr(
+            run_all.rq, "run", lambda cfg: calls.append(("rq", cfg.get("projects")))
+        )
+        monkeypatch.setattr(
+            run_all.moeva, "run",
+            lambda cfg: calls.append(("moeva", cfg["attack_name"])),
+        )
+        import pathlib
+
+        config_dir = pathlib.Path(__file__).resolve().parents[1] / "config"
+        run_all.run(str(config_dir))
+        kinds = [k for k, _ in calls]
+        assert kinds == ["rq"] * 6 + ["moeva"] * 2 + ["rq"] * 4
+        # every grid carried its project list; rq4 points are moeva attacks
+        assert all(p for k, p in calls if k == "rq")

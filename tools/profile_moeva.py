@@ -18,7 +18,7 @@ N_GEN = int(os.environ.get("P_GENS", 50))
 
 from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
 from moeva2_ijcai22_replication_tpu.attacks.moeva.operators import make_offspring
-from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import NormState, survive
+from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import NormState, survive_batch
 from moeva2_ijcai22_replication_tpu.core import codec as codec_lib
 from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
 from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
@@ -100,12 +100,16 @@ timed("C full attack    ", full, params, x_init, mc, xl_ml, xu_ml, key)
 
 @jax.jit
 def scan_survive(pop_x, key):
+    # production path: survive_batch with the pallas association when the
+    # engine would use it (TPU)
     merged = jnp.concatenate([pop_x, pop_x[:, :n_off] * 1.001], axis=1)
     def step(carry, _):
         fpop, k, st = carry
         k, ks = jax.random.split(k)
-        mask, st, _ = jax.vmap(lambda kk, ff, s0: survive(kk, ff, asp, s0, pop_size))(
-            jax.random.split(ks, s), fpop, st)
+        mask, st, _ = survive_batch(
+            jax.random.split(ks, s), fpop, asp, st, pop_size,
+            use_pallas=moeva._use_pallas,
+        )
         return (fpop + 0.0 * mask.sum(), k, st), ()
     f0, _ = moeva._evaluate(params, merged, x_init, x_init_mm, xl_ml, xu_ml, mc)
     st0 = jax.vmap(lambda _: NormState.init(3, moeva.dtype))(jnp.arange(s))

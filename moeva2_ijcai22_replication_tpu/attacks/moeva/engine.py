@@ -92,6 +92,14 @@ class Moeva2:
     #: in the generation budget: converged late populations can no longer
     #: lose the constrained adversarials found mid-run.
     archive_size: int = 0
+    #: niche-association backend: None = auto (Pallas kernel on TPU, XLA
+    #: elsewhere); False forces the XLA path. The Pallas kernel is validated
+    #: at the rq1/bench shapes, but one large-program configuration
+    #: (S≈640 states x pop 200 on LCLD inside the defense pipeline) has been
+    #: observed to hard-crash the TPU runtime — callers hitting such a fault
+    #: can pin False (or set MOEVA_DISABLE_PALLAS=1) without losing
+    #: correctness, only the ~20% survival speedup.
+    use_pallas: bool | None = None
     save_history: str | None = None
     #: generations per jitted scan segment when history is recorded; each
     #: segment's records are offloaded to host so "full" history at rq1 scale
@@ -132,8 +140,15 @@ class Moeva2:
         self._jit_segment = None
         # Pallas-fused niche association on TPU (shard_map'd over the states
         # axis under a mesh); XLA einsum path elsewhere (decided at trace
-        # time — the backend is fixed per process).
-        self._use_pallas = jax.default_backend() == "tpu"
+        # time — the backend is fixed per process). MOEVA_DISABLE_PALLAS=1
+        # forces the XLA path (triage escape hatch).
+        import os
+
+        if self.use_pallas is None:
+            disabled = os.environ.get("MOEVA_DISABLE_PALLAS", "") not in ("", "0")
+            self._use_pallas = jax.default_backend() == "tpu" and not disabled
+        else:
+            self._use_pallas = bool(self.use_pallas)
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):

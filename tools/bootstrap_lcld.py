@@ -1,0 +1,121 @@
+"""Bootstrap the LCLD artifact family without the raw LendingClub CSV.
+
+The reference's LCLD experiment chain consumes artifacts its defense
+pipeline derives from the (non-redistributed) raw export: candidate sets,
+scalers, and the five defended/undefended models under ``./data/lcld`` +
+``./models/lcld``. This tool builds the same family from synthetic
+constraint-valid rows (``domains/synth.py``), labelled by the committed
+reference model so the learning task matches the real decision surface,
+then runs the defense pipeline (``experiments/defense.py``) end to end.
+
+After this, every ``config/*.lcld*.yaml`` grid point is runnable::
+
+    python tools/bootstrap_lcld.py            # writes ./data/lcld ./models/lcld
+    python -m moeva2_ijcai22_replication_tpu.experiments.run_all
+
+Knobs via env: BOOT_TRAIN / BOOT_TEST (row counts), BOOT_BUDGET (MoEvA
+generations inside the pipeline).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.experiments import defense
+from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+REF = "/root/reference"
+N_TRAIN = int(os.environ.get("BOOT_TRAIN", 4000))
+N_TEST = int(os.environ.get("BOOT_TEST", 2000))
+BUDGET = int(os.environ.get("BOOT_BUDGET", 100))
+
+
+def main():
+    cons = LcldConstraints(
+        f"{REF}/data/lcld/features.csv", f"{REF}/data/lcld/constraints.csv"
+    )
+    # label with the committed reference classifier: the synthetic features
+    # then carry the same decision surface the attacks target. Synthetic
+    # rows are mostly "fully paid" under that model, so rejection-sample
+    # batches until both classes reach their quota (~1/3 positives).
+    ref_model = load_classifier(f"{REF}/models/lcld/nn.model")
+    ref_scaler = load_joblib_scaler(f"{REF}/models/lcld/scaler.joblib")
+
+    n_total = N_TRAIN + N_TEST
+    want_pos = n_total // 3
+    pos, neg = [], []
+    for seed in range(42, 142):
+        xb = synth_lcld(20000, cons.schema, seed=seed)
+        proba = np.asarray(
+            ref_model.predict_proba(ref_scaler.transform(jnp.asarray(xb)))
+        )[:, 1]
+        yb = proba >= 0.5
+        pos.append(xb[yb])
+        neg.append(xb[~yb])
+        if sum(len(p) for p in pos) >= want_pos and sum(
+            len(q) for q in neg
+        ) >= n_total - want_pos:
+            break
+    n_pos = sum(len(p) for p in pos)
+    n_neg = sum(len(q) for q in neg)
+    if n_pos < want_pos or n_neg < n_total - want_pos:
+        raise RuntimeError(
+            f"class quota not met after sampling: {n_pos} positives "
+            f"(need {want_pos}), {n_neg} negatives (need {n_total - want_pos}) "
+            "— raise the batch budget or lower BOOT_TRAIN/BOOT_TEST"
+        )
+    x = np.concatenate(
+        [np.concatenate(pos)[:want_pos], np.concatenate(neg)[: n_total - want_pos]]
+    )
+    rng = np.random.default_rng(0)
+    x = x[rng.permutation(len(x))]
+    cons.check_constraints_error(x)
+    proba = np.asarray(
+        ref_model.predict_proba(ref_scaler.transform(jnp.asarray(x)))
+    )[:, 1]
+    y = (proba >= 0.5).astype(np.int64)
+    print(f"labelled {len(x)} rows; positive rate {y.mean():.3f}")
+
+    os.makedirs("data/lcld", exist_ok=True)
+    for name, arr in [
+        ("x_train", x[:N_TRAIN]), ("x_test", x[N_TRAIN:]),
+        ("y_train", y[:N_TRAIN]), ("y_test", y[N_TRAIN:]),
+    ]:
+        np.save(f"data/lcld/{name}.npy", arr)
+
+    config = {
+        "project_name": "lcld",
+        "paths": {
+            "features": f"{REF}/data/lcld/features.csv",
+            "constraints": f"{REF}/data/lcld/constraints.csv",
+            "x_train": "data/lcld/x_train.npy",
+            "x_test": "data/lcld/x_test.npy",
+            "y_train": "data/lcld/y_train.npy",
+            "y_test": "data/lcld/y_test.npy",
+        },
+        "dirs": {"data": "data/lcld", "models": "models/lcld"},
+        "misclassification_threshold": 0.25,
+        "norm": 2,
+        "eps": 0.2,
+        "seed": 42,
+        "budget": BUDGET,
+        "n_pop": 200,
+        "n_offsprings": 100,
+        "system": {"n_jobs": 1, "verbose": 0},
+    }
+    artifacts = defense.run(config)
+    print("artifact family:")
+    for k, v in artifacts.items():
+        print(f"  {k}: {v}")
+
+
+if __name__ == "__main__":
+    main()

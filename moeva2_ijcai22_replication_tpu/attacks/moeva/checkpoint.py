@@ -1,0 +1,115 @@
+"""Mid-attack checkpointing: crash-recovery for long MoEvA runs.
+
+The reference recovers failed experiments only at whole-run granularity
+(config-hash skip, ``/root/reference/src/experiments/united/04_moeva.py:31-36``)
+— a crash 900 generations into an rq1 attack restarts from generation 0.
+SURVEY.md §5 calls out per-N-generation population checkpointing as the
+missing piece; this module adds it around the engine's segmented scan.
+
+Design: the evolution carry (populations, objectives, elite archive,
+normalisation memory, PRNG key) is a pytree of device arrays that fully
+determines the remaining computation — the PRNG key continues the exact
+random stream, so a resumed attack is bit-identical to an uninterrupted one.
+At each ``checkpoint_every``-generation boundary the carry is fetched and
+written atomically (tmp + rename) to one ``.npz``; per-segment history
+records stream to sidecar files as they are offloaded, so resume also
+restores ``save_history`` runs without ever re-buffering old generations.
+
+A fingerprint of the attack identity (inputs + every semantics-affecting
+knob) is stored in the checkpoint; a stale file from a different run is
+ignored, never resumed into. Successful completion removes the checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+
+_META = "__meta__"
+
+
+class AttackCheckpointer:
+    """Save/restore the engine's scan carry keyed by an attack fingerprint."""
+
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.hist_dir = path + ".hist"
+
+    # -- carry snapshots ----------------------------------------------------
+    def save(self, carry, done: int, n_hist: int) -> None:
+        """Atomically persist the carry after ``done`` generation steps."""
+        leaves, _ = jax.tree_util.tree_flatten(carry)
+        leaves = jax.device_get(leaves)
+        meta = json.dumps(
+            {
+                "fingerprint": self.fingerprint,
+                "done": int(done),
+                "n_leaves": len(leaves),
+                "n_hist": int(n_hist),
+            }
+        )
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+                **{_META: np.asarray(meta)},
+            )
+        os.replace(tmp, self.path)
+
+    def load(self, carry_template):
+        """Restore ``(carry, done, hist_chunks)`` or None.
+
+        ``carry_template`` (a freshly initialised carry) supplies the pytree
+        structure and the per-leaf device/sharding placement, so a resumed
+        mesh-sharded attack lands its shards back where the segment program
+        expects them.
+        """
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                meta = json.loads(str(z[_META]))
+                if meta.get("fingerprint") != self.fingerprint:
+                    return None
+                leaves = [z[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+        except Exception:
+            return None  # truncated/corrupt file: start fresh
+        tmpl_leaves, treedef = jax.tree_util.tree_flatten(carry_template)
+        if len(tmpl_leaves) != len(leaves):
+            return None
+        restored = [
+            jax.device_put(np.asarray(leaf), tmpl.sharding)
+            for leaf, tmpl in zip(leaves, tmpl_leaves)
+        ]
+        hist = []
+        for i in range(meta["n_hist"]):
+            try:
+                hist.append(np.load(self._hist_file(i)))
+            except Exception:
+                return None  # sidecar missing/truncated: start fresh
+        return treedef.unflatten(restored), meta["done"], hist
+
+    # -- history sidecars ---------------------------------------------------
+    def _hist_file(self, idx: int) -> str:
+        return os.path.join(self.hist_dir, f"chunk_{idx:05d}.npy")
+
+    def add_hist_chunk(self, idx: int, arr: np.ndarray) -> None:
+        os.makedirs(self.hist_dir, exist_ok=True)
+        tmp = os.path.join(self.hist_dir, ".tmp.npy")
+        np.save(tmp, arr)
+        os.replace(tmp, self._hist_file(idx))
+
+    # -- lifecycle ----------------------------------------------------------
+    def clear(self) -> None:
+        """Completed run: the recovery artifacts have served their purpose."""
+        for p in (self.path, self.path + ".tmp"):
+            if os.path.exists(p):
+                os.remove(p)
+        if os.path.isdir(self.hist_dir):
+            shutil.rmtree(self.hist_dir)

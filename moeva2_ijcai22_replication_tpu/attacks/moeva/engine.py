@@ -105,6 +105,14 @@ class Moeva2:
     #: segment's records are offloaded to host so "full" history at rq1 scale
     #: (1000 gens) never accumulates on device.
     history_chunk: int = 50
+    #: crash recovery (SURVEY §5's missing per-N-generation checkpointing —
+    #: the reference restarts a crashed attack from generation 0): every
+    #: ``checkpoint_every`` generations the evolution carry is written
+    #: atomically to ``checkpoint_path``; a rerun of the identical attack
+    #: resumes the random stream mid-run, bit-identical to an uninterrupted
+    #: one. 0 / None = off. Completed runs remove the checkpoint.
+    checkpoint_every: int = 0
+    checkpoint_path: str | None = None
     dtype: Any = jnp.float32
     mesh: jax.sharding.Mesh | None = None
     states_axis: str = "states"
@@ -387,28 +395,64 @@ class Moeva2:
             args = self._shard_args(args)
         params, x_dev, mc_dev, xl_dev, xu_dev, key = args
 
+        cp = None
+        if self.checkpoint_every and self.checkpoint_path:
+            from .checkpoint import AttackCheckpointer
+
+            cp = AttackCheckpointer(
+                self.checkpoint_path, self._fingerprint(x, minimize_class)
+            )
+
         t0 = time.time()
         carry, init_hist = self._jit_init(*args)
         n_steps = self.n_gen - 1
         # Without history a single segment reproduces the one-scan program;
         # with history, fixed-size segments bound HBM usage and each chunk's
-        # records move to host while the next segment runs.
+        # records move to host while the next segment runs. Checkpoint
+        # boundaries cap segment length so saves land exactly on multiples
+        # of ``checkpoint_every``.
         chunk = n_steps if not self.save_history else max(1, self.history_chunk)
         hist_chunks = []
         pending = None  # previous chunk's device buffer, fetched one dispatch late
         done = 0
+        if cp is not None:
+            resumed = cp.load(carry)
+            if resumed is not None:
+                carry, done, hist_chunks = resumed
         while done < n_steps:
             length = min(chunk, n_steps - done)
+            if cp is not None:
+                length = min(
+                    length, self.checkpoint_every - done % self.checkpoint_every
+                )
             carry, gen_hist = self._jit_segment(
                 params, x_dev, mc_dev, xl_dev, xu_dev, carry, length=length
             )
+            done += length
             if self.save_history:
                 # the next segment is already enqueued (async dispatch), so
-                # this transfer overlaps with its compute
+                # fetching the *previous* chunk overlaps with its compute;
+                # with checkpointing the fetched chunk also lands on disk so
+                # the next carry snapshot can claim it
                 if pending is not None:
-                    hist_chunks.append(np.asarray(jax.device_get(pending)))
+                    arr = np.asarray(jax.device_get(pending))
+                    if cp is not None:
+                        cp.add_hist_chunk(len(hist_chunks), arr)
+                    hist_chunks.append(arr)
                 pending = gen_hist
-            done += length
+            if (
+                cp is not None
+                and done < n_steps
+                and done % self.checkpoint_every == 0
+            ):
+                # a snapshot only counts history already durable on disk:
+                # flush the in-flight chunk before writing the carry
+                if pending is not None:
+                    arr = np.asarray(jax.device_get(pending))
+                    cp.add_hist_chunk(len(hist_chunks), arr)
+                    hist_chunks.append(arr)
+                    pending = None
+                cp.save(carry, done, n_hist=len(hist_chunks))
         if pending is not None:
             hist_chunks.append(np.asarray(jax.device_get(pending)))
         pop_x, pop_f, arch_x, arch_f, _, _ = carry
@@ -418,6 +462,8 @@ class Moeva2:
             pop_f = jnp.concatenate([pop_f, arch_f], axis=1)
         pop_x, pop_f = jax.device_get((pop_x, pop_f))
         elapsed = time.time() - t0
+        if cp is not None:
+            cp.clear()  # run finished: recovery artifacts no longer needed
 
         history = None
         if self.save_history:
@@ -455,6 +501,35 @@ class Moeva2:
             time=elapsed,
             history=history,
         )
+
+    def _fingerprint(self, x: np.ndarray, minimize_class: np.ndarray) -> str:
+        """Attack identity for checkpoint validity: the inputs plus every
+        ingredient that changes the computation — engine knobs, classifier
+        weights, scaler, and constraint set (a model retrained to the same
+        path between crash and rerun must invalidate the checkpoint). A
+        checkpoint whose fingerprint differs is ignored (fresh start),
+        never resumed into."""
+        import hashlib
+
+        h = hashlib.md5()
+        h.update(np.ascontiguousarray(x).tobytes())
+        h.update(np.ascontiguousarray(minimize_class).tobytes())
+        knobs = [
+            self.n_gen, self.pop_size, self.n_offsprings, self.seed,
+            self.init, self.init_eps, self.init_ratio, self.archive_size,
+            str(self.save_history), str(self.norm), self.crossover_prob,
+            self.eta_mutation, str(np.dtype(self.dtype)),
+            type(self.constraints).__name__,
+        ]
+        h.update(repr(knobs).encode())
+        for leaf in jax.tree_util.tree_leaves(self.classifier.params):
+            h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+        if self.ml_scaler is not None:
+            h.update(np.ascontiguousarray(self.ml_scaler.scale).tobytes())
+            h.update(np.ascontiguousarray(self.ml_scaler.min_).tobytes())
+        schema = self.constraints.schema
+        h.update(repr([list(map(str, schema.types)), schema.mutable.tolist()]).encode())
+        return h.hexdigest()
 
     def _shard_args(self, args):
         """Shard the states axis over the mesh; replicate params/key."""

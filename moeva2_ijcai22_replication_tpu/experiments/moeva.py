@@ -64,6 +64,11 @@ def run(config: dict):
         init_ratio=config.get("init_ratio", 0.5),
         archive_size=config.get("archive_size", 0),
         save_history=config.get("save_history") or None,
+        # crash recovery: a rerun of this config hash resumes mid-attack
+        # from the last ``checkpoint_every``-generation boundary instead of
+        # generation 0 (config-hash skip only covers *completed* runs)
+        checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
+        checkpoint_path=f"{out_dir}/checkpoint_{mid_fix}_{config_hash}.npz",
         mesh=common.build_mesh(config),
     )
     with timer.phase("attack"), maybe_profile(

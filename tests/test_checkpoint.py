@@ -1,0 +1,168 @@
+"""Mid-attack checkpoint/resume: crash recovery with bit-identical results.
+
+The reference can only restart a crashed attack from generation 0 (config-hash
+skip covers completed runs only, ``04_moeva.py:31-36``); the engine's
+``checkpoint_every`` closes that gap. Because the checkpoint carries the PRNG
+key, a resumed run continues the exact random stream: these tests kill an
+attack mid-run with an injected fault and assert the resumed result equals an
+uninterrupted run bit for bit, history included.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.moeva.checkpoint import AttackCheckpointer
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+
+class _InjectedCrash(RuntimeError):
+    pass
+
+
+@pytest.fixture(scope="module")
+def problem(lcld_paths):
+    constraints = LcldConstraints(lcld_paths["features"], lcld_paths["constraints"])
+    model = lcld_mlp()
+    params = init_params(model, constraints.schema.n_features, seed=7)
+    surrogate = Surrogate(model=model, params=params)
+    x = synth_lcld(4, constraints.schema, seed=3)
+    scaler = fit_minmax(x.min(0), x.max(0))
+    return constraints, surrogate, x, scaler
+
+
+def _engine(problem, save_history, seed=11, **kw):
+    constraints, surrogate, _, scaler = problem
+    return Moeva2(
+        classifier=surrogate,
+        constraints=constraints,
+        ml_scaler=scaler,
+        norm=2,
+        n_gen=10,
+        n_pop=20,
+        n_offsprings=10,
+        seed=seed,
+        archive_size=2,
+        save_history=save_history,
+        history_chunk=2,
+        dtype=jnp.float64,
+        **kw,
+    )
+
+
+def _crash_on_call(engine, n):
+    """Arm the engine with the real segment program wrapped in a fault that
+    fires on the ``n``-th dispatch."""
+    engine._jit_init = jax.jit(engine._build_init())
+    real_segment = jax.jit(engine._build_segment(), static_argnames="length")
+    calls = {"n": 0}
+
+    def crashing(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == n:
+            raise _InjectedCrash()
+        return real_segment(*args, **kwargs)
+
+    engine._jit_segment = crashing
+
+
+@pytest.mark.parametrize("save_history", ["reduced", None])
+def test_resume_is_bit_identical(problem, tmp_path, save_history):
+    _, _, x, _ = problem
+    reference = _engine(problem, save_history).generate(x)
+
+    cp_path = str(tmp_path / f"cp_{save_history}.npz")
+    crashed = _engine(
+        problem, save_history, checkpoint_every=3, checkpoint_path=cp_path
+    )
+    _crash_on_call(crashed, 3)
+    with pytest.raises(_InjectedCrash):
+        crashed.generate(x)
+    assert os.path.exists(cp_path), "crash after a boundary must leave a checkpoint"
+
+    resumed = _engine(
+        problem, save_history, checkpoint_every=3, checkpoint_path=cp_path
+    ).generate(x)
+
+    np.testing.assert_array_equal(resumed.x_gen, reference.x_gen)
+    np.testing.assert_array_equal(resumed.f, reference.f)
+    if save_history:
+        # entry 0 = initial population record, then one per generation
+        np.testing.assert_array_equal(resumed.history[0], reference.history[0])
+        np.testing.assert_array_equal(
+            np.stack(resumed.history[1:]), np.stack(reference.history[1:])
+        )
+    assert not os.path.exists(cp_path), "completed run must clear its checkpoint"
+    assert not os.path.isdir(cp_path + ".hist")
+
+
+def test_stale_checkpoint_from_different_run_is_ignored(problem, tmp_path):
+    _, _, x, _ = problem
+    cp_path = str(tmp_path / "cp.npz")
+
+    crashed = _engine(problem, None, checkpoint_every=3, checkpoint_path=cp_path)
+    _crash_on_call(crashed, 3)
+    with pytest.raises(_InjectedCrash):
+        crashed.generate(x)
+    assert os.path.exists(cp_path)
+
+    # Same path, different seed: the fingerprint differs, so the checkpoint
+    # must be ignored — the run starts fresh and matches a checkpoint-free
+    # run of the new seed exactly.
+    fresh = _engine(problem, None, seed=12).generate(x)
+    resumed = _engine(
+        problem, None, seed=12, checkpoint_every=3, checkpoint_path=cp_path
+    ).generate(x)
+    np.testing.assert_array_equal(resumed.x_gen, fresh.x_gen)
+    np.testing.assert_array_equal(resumed.f, fresh.f)
+
+
+def test_fingerprint_covers_model_scaler_and_inputs(problem):
+    constraints, surrogate, x, scaler = problem
+    mc = np.ones(len(x), dtype=int)
+    base = _engine(problem, None)._fingerprint(x, mc)
+    # same knobs, different classifier weights -> different identity
+    model = lcld_mlp()
+    other = Surrogate(model, init_params(model, constraints.schema.n_features, seed=99))
+    retrained = Moeva2(
+        classifier=other, constraints=constraints, ml_scaler=scaler,
+        norm=2, n_gen=10, n_pop=20, n_offsprings=10, seed=11,
+        archive_size=2, dtype=jnp.float64,
+    )
+    assert retrained._fingerprint(x, mc) != base
+    # different inputs -> different identity
+    assert _engine(problem, None)._fingerprint(x + 1e-3, mc) != base
+    # identical run -> stable identity
+    assert _engine(problem, None)._fingerprint(x, mc) == base
+
+
+def test_corrupt_checkpoint_falls_back_to_fresh_start(problem, tmp_path):
+    _, _, x, _ = problem
+    cp_path = str(tmp_path / "cp.npz")
+    with open(cp_path, "wb") as fh:
+        fh.write(b"not an npz")
+    result = _engine(
+        problem, None, checkpoint_every=4, checkpoint_path=cp_path
+    ).generate(x)
+    reference = _engine(problem, None).generate(x)
+    np.testing.assert_array_equal(result.x_gen, reference.x_gen)
+
+
+def test_checkpointer_rejects_wrong_fingerprint(tmp_path):
+    path = str(tmp_path / "cp.npz")
+    carry = (jnp.arange(3.0), jnp.ones((2, 2)))
+    AttackCheckpointer(path, "fp-a").save(carry, done=5, n_hist=0)
+    assert AttackCheckpointer(path, "fp-b").load(carry) is None
+    restored = AttackCheckpointer(path, "fp-a").load(carry)
+    assert restored is not None
+    loaded_carry, done, hist = restored
+    assert done == 5 and hist == []
+    np.testing.assert_array_equal(np.asarray(loaded_carry[0]), np.arange(3.0))

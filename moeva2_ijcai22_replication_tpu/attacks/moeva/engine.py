@@ -400,7 +400,8 @@ class Moeva2:
             from .checkpoint import AttackCheckpointer
 
             cp = AttackCheckpointer(
-                self.checkpoint_path, self._fingerprint(x, minimize_class)
+                self.checkpoint_path,
+                self._fingerprint(x, minimize_class, xl_ml, xu_ml),
             )
 
         t0 = time.time()
@@ -429,29 +430,31 @@ class Moeva2:
                 params, x_dev, mc_dev, xl_dev, xu_dev, carry, length=length
             )
             done += length
+
+            def flush_pending():
+                # fetch the in-flight chunk; with checkpointing it also
+                # lands on disk so a later carry snapshot can claim it
+                nonlocal pending
+                if pending is None:
+                    return
+                arr = np.asarray(jax.device_get(pending))
+                if cp is not None:
+                    cp.add_hist_chunk(len(hist_chunks), arr)
+                hist_chunks.append(arr)
+                pending = None
+
             if self.save_history:
                 # the next segment is already enqueued (async dispatch), so
-                # fetching the *previous* chunk overlaps with its compute;
-                # with checkpointing the fetched chunk also lands on disk so
-                # the next carry snapshot can claim it
-                if pending is not None:
-                    arr = np.asarray(jax.device_get(pending))
-                    if cp is not None:
-                        cp.add_hist_chunk(len(hist_chunks), arr)
-                    hist_chunks.append(arr)
+                # fetching the *previous* chunk overlaps with its compute
+                flush_pending()
                 pending = gen_hist
             if (
                 cp is not None
                 and done < n_steps
                 and done % self.checkpoint_every == 0
             ):
-                # a snapshot only counts history already durable on disk:
-                # flush the in-flight chunk before writing the carry
-                if pending is not None:
-                    arr = np.asarray(jax.device_get(pending))
-                    cp.add_hist_chunk(len(hist_chunks), arr)
-                    hist_chunks.append(arr)
-                    pending = None
+                # a snapshot only counts history already durable on disk
+                flush_pending()
                 cp.save(carry, done, n_hist=len(hist_chunks))
         if pending is not None:
             hist_chunks.append(np.asarray(jax.device_get(pending)))
@@ -502,24 +505,36 @@ class Moeva2:
             history=history,
         )
 
-    def _fingerprint(self, x: np.ndarray, minimize_class: np.ndarray) -> str:
+    def _fingerprint(
+        self,
+        x: np.ndarray,
+        minimize_class: np.ndarray,
+        xl_ml: np.ndarray,
+        xu_ml: np.ndarray,
+    ) -> str:
         """Attack identity for checkpoint validity: the inputs plus every
-        ingredient that changes the computation — engine knobs, classifier
-        weights, scaler, and constraint set (a model retrained to the same
-        path between crash and rerun must invalidate the checkpoint). A
-        checkpoint whose fingerprint differs is ignored (fresh start),
-        never resumed into."""
+        *data* ingredient that changes the computation — engine knobs,
+        classifier weights, scaler, feature bounds, and the constraint set's
+        schema identity (a model retrained to the same path, or a features
+        CSV edited, between crash and rerun must invalidate the checkpoint).
+        Constraint *formulas* are code, not data: changing them means
+        changing this package, which ships with its own tests. A checkpoint
+        whose fingerprint differs is ignored (fresh start), never resumed
+        into."""
         import hashlib
 
         h = hashlib.md5()
         h.update(np.ascontiguousarray(x).tobytes())
         h.update(np.ascontiguousarray(minimize_class).tobytes())
+        h.update(np.ascontiguousarray(xl_ml).tobytes())
+        h.update(np.ascontiguousarray(xu_ml).tobytes())
         knobs = [
             self.n_gen, self.pop_size, self.n_offsprings, self.seed,
             self.init, self.init_eps, self.init_ratio, self.archive_size,
             str(self.save_history), str(self.norm), self.crossover_prob,
             self.eta_mutation, str(np.dtype(self.dtype)),
             type(self.constraints).__name__,
+            self.constraints.get_nb_constraints(),
         ]
         h.update(repr(knobs).encode())
         for leaf in jax.tree_util.tree_leaves(self.classifier.params):

@@ -20,8 +20,11 @@ fidelity limits vs the reference:
   (ratio denominators, dates) remain pinned at hot-start values, with every
   zero/degenerate pin detected and mapped to the infeasible fallback.
 - The L2 ε-ball (Gurobi pow-constraint, ``sat.py:98-124``) is inscribed by
-  the per-feature box of scaled radius ε/√D — solutions remain valid L2
-  members, the search space is just smaller.
+  a per-feature box with Σ radius² = ε² — solutions remain valid L2
+  members, the search space is just smaller. The box is directional: radii
+  follow the hot-start displacement, so a PGD-steered repair keeps almost
+  the full ε budget on the features the gradient attack actually moved
+  (uniform ε/√D only in the no-hot-start case).
 - Gurobi's solution pool (PoolSolutions=n_sample, ``sat.py:167-173``) is
   emulated with no-good cuts over the program's binary variables (one-hot
   members, mode binaries): each re-solve excludes all previous binary
@@ -86,6 +89,35 @@ class SatAttack:
         self._min = np.asarray(self.min_max_scaler.min_)
 
     # -- per-state program --------------------------------------------------
+    def _box_radii(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
+        """Per-feature half-widths of the ε-box in scaled space (sat.py:85-97).
+
+        L∞ is the box itself. The L2 ball (Gurobi quadratic pow-constraint,
+        ``sat.py:98-124``) has no linear encoding, so it is inscribed by a
+        box with Σ radius² = ε² — every solution remains a valid L2 member.
+        The budget goes only to features the MILP can actually move (mutable,
+        nonzero scale; pinned dims contribute zero displacement, so weighting
+        them would only shrink everyone else), and the box is *directional*:
+        radii follow the hot-start displacement |hot − x_init| with a 10%
+        uniform floor so unmoved features keep room. Displacements below
+        ε/100 are treated as zero — PGD converging at x_init must not let
+        float noise steer the box — degrading to the uniform inscribed
+        box ε/√m over the m movable features.
+        """
+        d = x_init.shape[0]
+        if is_inf(self.norm):
+            return np.full(d, self.eps)
+        movable = self._mutable & (self._scale != 0)
+        if not movable.any():
+            return np.full(d, self.eps / np.sqrt(d))
+        delta = np.abs((hot - x_init) * self._scale)
+        delta = np.where(movable & (delta > self.eps / 100.0), delta, 0.0)
+        if delta.max() > 0:
+            weights = np.where(movable, delta + delta.max() / 10.0, 0.0)
+        else:
+            weights = movable.astype(float)
+        return self.eps * weights / np.linalg.norm(weights)
+
     def _one_generate(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
         from scipy import optimize, sparse
 
@@ -94,8 +126,7 @@ class SatAttack:
         xl = np.asarray(xl, dtype=float).copy()
         xu = np.asarray(xu, dtype=float).copy()
 
-        # ε-box in scaled space (sat.py:85-97); L2 ball inscribed by a box.
-        radius = self.eps if is_inf(self.norm) else self.eps / np.sqrt(d)
+        radius = self._box_radii(x_init, hot)
         s_init = x_init * self._scale + self._min
         nonzero = self._scale != 0
         lo_box = np.where(

@@ -14,10 +14,14 @@ HiGHS formulation:
   (1+r)^term power never has to live inside the MILP).
 - the ratio denominators annual_inc, total_acc, pub_rec and both date
   features are pinned at hot-start values, so g5/g6/g8/g9/g10 are linear and
-  g7 fixes the month-difference feature to a constant. Every pin that lands
-  on a zero denominator (annual_inc, total_acc, or a zero month difference)
-  makes the corresponding equality unsatisfiable — the builder flags the
-  program infeasible instead of emitting inf coefficients.
+  g7 fixes the month-difference feature to a constant. The pins on issue_d,
+  earliest_cr_line and pub_rec are **exact** (those features are immutable
+  in the schema, so every attack leaves them at the initial value anyway);
+  the only genuine search-power loss vs the reference's nonconvex bilinear
+  rows is the two mutable denominators annual_inc and total_acc. Every pin
+  that lands on a zero denominator (annual_inc, total_acc, or a zero month
+  difference) makes the corresponding equality unsatisfiable — the builder
+  flags the program infeasible instead of emitting inf coefficients.
 - one-hot groups: integral 0/1 members summing to 1.
 
 The MILP searches term, loan_amnt, installment, open_acc,

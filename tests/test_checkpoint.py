@@ -125,10 +125,13 @@ def test_stale_checkpoint_from_different_run_is_ignored(problem, tmp_path):
     np.testing.assert_array_equal(resumed.f, fresh.f)
 
 
-def test_fingerprint_covers_model_scaler_and_inputs(problem):
+def test_fingerprint_covers_model_scaler_bounds_and_inputs(problem):
     constraints, surrogate, x, scaler = problem
     mc = np.ones(len(x), dtype=int)
-    base = _engine(problem, None)._fingerprint(x, mc)
+    xl, xu = constraints.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    base = _engine(problem, None)._fingerprint(x, mc, xl, xu)
     # same knobs, different classifier weights -> different identity
     model = lcld_mlp()
     other = Surrogate(model, init_params(model, constraints.schema.n_features, seed=99))
@@ -137,11 +140,12 @@ def test_fingerprint_covers_model_scaler_and_inputs(problem):
         norm=2, n_gen=10, n_pop=20, n_offsprings=10, seed=11,
         archive_size=2, dtype=jnp.float64,
     )
-    assert retrained._fingerprint(x, mc) != base
-    # different inputs -> different identity
-    assert _engine(problem, None)._fingerprint(x + 1e-3, mc) != base
+    assert retrained._fingerprint(x, mc, xl, xu) != base
+    # different inputs or edited feature bounds -> different identity
+    assert _engine(problem, None)._fingerprint(x + 1e-3, mc, xl, xu) != base
+    assert _engine(problem, None)._fingerprint(x, mc, xl, xu * 1.01) != base
     # identical run -> stable identity
-    assert _engine(problem, None)._fingerprint(x, mc) == base
+    assert _engine(problem, None)._fingerprint(x, mc, xl, xu) == base
 
 
 def test_corrupt_checkpoint_falls_back_to_fresh_start(problem, tmp_path):

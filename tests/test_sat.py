@@ -106,6 +106,75 @@ class TestLcldSat:
         os_ = np.asarray(scaler.transform(jnp.asarray(out)))
         assert np.linalg.norm(os_ - xs, axis=1).max() <= 0.2 + 1e-6
 
+    def test_l2_box_is_directional_toward_hot_start(self, lcld_setup):
+        """A hot start concentrated on one feature must keep (almost) the
+        full ε budget there: the directional inscribed box admits moves far
+        beyond the uniform ε/√D sliver, while every solution stays a valid
+        L2-ball member."""
+        cons, x, scaler = lcld_setup
+        eps = 0.2
+        feat = 12  # revol_bal: mutable, continuous, in no LCLD constraint
+        scale = np.asarray(scaler.scale)
+
+        # push 90% of ε onto the one feature, toward whichever side of the
+        # (scaled) range has headroom so feature bounds cannot clamp the move
+        xs0 = np.asarray(scaler.transform(jnp.asarray(x)))
+        sign = np.where(xs0[:, feat] < 0.5, 1.0, -1.0)
+        hot = x.copy()
+        hot[:, feat] += sign * 0.9 * eps / scale[feat]
+
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=eps,
+            norm=2,
+        )
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        os_ = np.asarray(scaler.transform(jnp.asarray(out)))
+        # still inside the L2 ball ...
+        assert np.linalg.norm(os_ - xs, axis=1).max() <= eps + 1e-6
+        # ... yet the moved feature retains far more than the uniform
+        # inscribed box could ever allow (ε/√D ≈ 0.029 ≪ 0.8ε)
+        moved = np.abs(os_[:, feat] - xs[:, feat])
+        assert moved.min() >= 0.8 * eps
+
+    def test_l2_box_radii_budget_and_noise_floor(self, lcld_setup):
+        """Radii spend the ε budget only on movable features (Σ r² = ε²
+        over mutables, zero on immutables), and a noise-scale hot-start
+        displacement must not steer the box away from uniform."""
+        cons, x, scaler = lcld_setup
+        eps = 0.2
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=eps,
+            norm=2,
+        )
+        movable = atk._mutable & (np.asarray(scaler.scale) != 0)
+        m = movable.sum()
+
+        # no hot start: uniform ε/√m over movables, zero on pinned dims
+        r = atk._box_radii(x[0], x[0])
+        assert np.allclose(r[movable], eps / np.sqrt(m))
+        assert np.all(r[~movable] == 0)
+        assert np.isclose((r**2).sum(), eps**2)
+
+        # float-noise displacement (PGD converged at x_init): still uniform
+        hot = x[0].copy()
+        hot[np.flatnonzero(movable)[0]] += 1e-12
+        np.testing.assert_allclose(atk._box_radii(x[0], hot), r)
+
+        # a real displacement concentrates budget but keeps Σ r² = ε²
+        hot = x[0].copy()
+        feat = 12  # revol_bal
+        hot[feat] += 0.5 * eps / np.asarray(scaler.scale)[feat]
+        r_dir = atk._box_radii(x[0], hot)
+        assert r_dir[feat] > 3 * r[feat]
+        assert np.isclose((r_dir**2).sum(), eps**2)
+
 
 class TestBotnetSat:
     def test_real_candidates_stay_valid(self, botnet_paths, botnet_candidates):

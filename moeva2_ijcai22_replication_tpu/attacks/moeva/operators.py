@@ -15,11 +15,15 @@ two-point crossover is one comparison against two sampled cut points —
 no ragged sub-vectors, no gathers. Everything broadcasts over leading batch
 axes ``(n_states, n_matings, ...)`` and is vmap/shard_map-safe.
 
-Deliberate gap: the reference also registers softmax-renormalising crossover
-and mutation operators for a "softmax" gene type
-(``softmax_crossover.py:9-42``, ``softmax_mutation.py:8-71``), but the type
-mask that would activate them is commented out (``moeva2.py:89``) and no
-dataset declares softmax genes — dead code by construction, not ported.
+The "softmax" gene type (dormant in the reference — registered operators
+``softmax_crossover.py:9-42`` / ``softmax_mutation.py:8-71`` behind a
+commented-out type mask, ``moeva2.py:89``; no shipped dataset uses it) is
+supported as a third type family: all softmax genes form one sub-vector that
+gets its own two-point crossover and polynomial mutation, and is renormalised
+with a softmax afterwards — after crossover only for matings whose crossover
+coin fired (pymoo copies un-crossed parents verbatim past ``_do``), after
+mutation for every offspring row (the reference applies ``softmax(Y)``
+unconditionally).
 """
 
 from __future__ import annotations
@@ -37,21 +41,32 @@ class OperatorTables(NamedTuple):
     """Static per-gene tables for mixed-variable operators.
 
     ``type_id``: 0 = real, 1 = int (categorical genes count as int, matching
-    the reference's type mask where OHE groups become single int genes).
+    the reference's type mask where OHE groups become single int genes),
+    2 = softmax (one probability-simplex sub-vector, renormalised after the
+    operators). Tables are closed over by the jitted programs, so
+    ``has_softmax`` stays a static Python bool.
     """
 
     type_id: jnp.ndarray  # (L,) int32
     rank_in_type: jnp.ndarray  # (L,) int32 — position within own type
-    type_sizes: jnp.ndarray  # (2,) int32 — [n_real, n_int]
+    type_sizes: jnp.ndarray  # (3,) int32 — [n_real, n_int, n_softmax]
     mut_prob: jnp.ndarray  # (L,) float — 1 / n_type (pymoo sub-problem prob)
     int_mask: jnp.ndarray  # (L,) bool
+    softmax_mask: jnp.ndarray  # (L,) bool
+    has_softmax: bool
 
 
 def make_operator_tables(codec: Codec) -> OperatorTables:
     int_mask = np.asarray(codec.int_mask_gen)
-    type_id = int_mask.astype(np.int32)
-    rank = np.zeros(len(int_mask), dtype=np.int32)
-    counters = [0, 0]
+    length = len(int_mask)
+    softmax_mask = (
+        np.zeros(length, dtype=bool)
+        if codec.softmax_mask_gen is None
+        else np.asarray(codec.softmax_mask_gen)
+    )
+    type_id = np.where(softmax_mask, 2, int_mask.astype(np.int32)).astype(np.int32)
+    rank = np.zeros(length, dtype=np.int32)
+    counters = [0, 0, 0]
     for i, t in enumerate(type_id):
         rank[i] = counters[t]
         counters[t] += 1
@@ -63,7 +78,28 @@ def make_operator_tables(codec: Codec) -> OperatorTables:
         type_sizes=jnp.asarray(sizes),
         mut_prob=jnp.asarray(mut_prob),
         int_mask=jnp.asarray(int_mask),
+        softmax_mask=jnp.asarray(softmax_mask),
+        has_softmax=bool(softmax_mask.any()),
     )
+
+
+def softmax_renorm(
+    mask: jnp.ndarray, x: jnp.ndarray, rows: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Softmax over the masked sub-vector of each row; other genes untouched.
+
+    Reference semantics (``softmax_crossover.py:40``, ``softmax_mutation.py:69``):
+    the gene *values* are treated as logits. ``rows`` (broadcastable bool)
+    restricts which rows are renormalised.
+    """
+    logits = jnp.where(mask, x, -jnp.inf)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)  # -inf pads -> exactly 0
+    s = e / jnp.sum(e, axis=-1, keepdims=True)
+    out = jnp.where(mask, s, x)
+    if rows is not None:
+        out = jnp.where(rows, out, x)
+    return out
 
 
 def select_parent_pairs(key: jax.Array, n_matings: int, pop_size: int) -> jnp.ndarray:
@@ -112,20 +148,32 @@ def two_point_crossover(
     runs each sub-crossover's own ``do`` with its own prob gate).
     """
     batch = p1.shape[:-1]
-    k_coin_r, k_coin_i, k_real, k_int = jax.random.split(key, 4)
+    k_coin_r, k_coin_i, k_coin_s, k_real, k_int, k_sm = jax.random.split(key, 6)
 
     lo_r, hi_r = _two_cuts(k_real, tables.type_sizes[0], batch)
     lo_i, hi_i = _two_cuts(k_int, tables.type_sizes[1], batch)
+    lo_s, hi_s = _two_cuts(k_sm, tables.type_sizes[2], batch)
     do_r = jax.random.uniform(k_coin_r, batch) < prob
     do_i = jax.random.uniform(k_coin_i, batch) < prob
+    do_s = jax.random.uniform(k_coin_s, batch) < prob
 
     is_real = tables.type_id == 0
-    lo = jnp.where(is_real, lo_r[..., None], lo_i[..., None])
-    hi = jnp.where(is_real, hi_r[..., None], hi_i[..., None])
-    do = jnp.where(is_real, do_r[..., None], do_i[..., None])
+    is_int = tables.type_id == 1
+    pick = lambda r, i, s: jnp.where(
+        is_real, r[..., None], jnp.where(is_int, i[..., None], s[..., None])
+    )
+    lo = pick(lo_r, lo_i, lo_s)
+    hi = pick(hi_r, hi_i, hi_s)
+    do = pick(do_r, do_i, do_s)
     swap = (tables.rank_in_type >= lo) & (tables.rank_in_type < hi) & do
     c1 = jnp.where(swap, p2, p1)
     c2 = jnp.where(swap, p1, p2)
+    if tables.has_softmax:
+        # crossed matings re-project onto the simplex (softmax_crossover.py:40);
+        # un-crossed matings are verbatim parent copies in pymoo and skip it
+        rows = do_s[..., None]
+        c1 = softmax_renorm(tables.softmax_mask, c1, rows)
+        c2 = softmax_renorm(tables.softmax_mask, c2, rows)
     return c1, c2
 
 
@@ -174,7 +222,12 @@ def polynomial_mutation(
     do = (jax.random.uniform(k_sel, x.shape, dtype=x.dtype) < tables.mut_prob) & ok
     y = jnp.where(do, x + deltaq * safe_rng, x)
     y = jnp.where(tables.int_mask, jnp.round(y), y)
-    return jnp.clip(y, xl, xu)
+    y = jnp.clip(y, xl, xu)
+    if tables.has_softmax:
+        # every row re-projects onto the simplex (softmax_mutation.py:69
+        # applies softmax(Y) unconditionally after the bounds repair)
+        y = softmax_renorm(tables.softmax_mask, y)
+    return y
 
 
 def make_offspring(

@@ -299,8 +299,11 @@ def round_ints_toward_initial(
 ) -> np.ndarray:
     """Directional integer rounding (``united/01_pgd_united.py:130-137``):
     int features moved up are floored, moved down are ceiled — never
-    overshooting past the original value."""
-    int_mask = np.array([str(t) != "real" for t in feature_types])
+    overshooting past the original value. Softmax (simplex) features are
+    continuous and stay untouched."""
+    int_mask = np.array(
+        [str(t) not in ("real", "softmax") for t in feature_types]
+    )
     x = x_adv_unscaled.copy()
     up = x > x_init_unscaled
     vals = np.where(up, np.floor(x), np.ceil(x))

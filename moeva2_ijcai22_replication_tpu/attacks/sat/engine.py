@@ -83,7 +83,17 @@ class SatAttack:
     def __post_init__(self):
         validate_norm(self.norm)
         schema = self.constraints.schema
-        self._int_mask = np.array([str(t) != "real" for t in schema.types])
+        # int/ohe features become MILP integer variables; real and softmax
+        # (simplex) features stay continuous
+        self._int_mask = np.array(
+            [str(t) not in ("real", "softmax") for t in schema.types]
+        )
+        # the softmax sub-vector's Σ=1 simplex row is part of the type's
+        # meaning, so the engine adds it itself — like integer typing, it is
+        # derived from the schema, not left to the domain builders
+        self._softmax_idx = np.flatnonzero(
+            [str(t) == "softmax" for t in schema.types]
+        )
         self._mutable = np.asarray(schema.mutable, dtype=bool)
         self._scale = np.asarray(self.min_max_scaler.scale)
         self._min = np.asarray(self.min_max_scaler.min_)
@@ -145,6 +155,10 @@ class SatAttack:
         spec = self.sat_rows_builder(x_init, hot)
         if not spec.feasible:
             return np.tile(x_init, (self.n_sample, 1))
+        if len(self._softmax_idx):
+            spec.rows.append(
+                (self._softmax_idx, np.ones(len(self._softmax_idx)), 1.0, 1.0)
+            )
         # Pins must stay inside the ε-box ∩ feature bounds: a pin outside it
         # means the mode choice is unreachable within the budget — the
         # program is genuinely infeasible and we fall back to x_init

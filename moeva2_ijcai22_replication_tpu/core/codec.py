@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .schema import FeatureSchema, OHE_PREFIX
+from .schema import FeatureSchema, OHE_PREFIX, SOFTMAX_TYPE
 
 
 class Codec(NamedTuple):
@@ -48,6 +48,9 @@ class Codec(NamedTuple):
     mutable_mask: jnp.ndarray  # (D,) bool
     n_features: int  # static
     gen_length: int  # static
+    #: (L,) bool — genes forming the probability-simplex sub-vector
+    #: (schema type "softmax"); None means the schema declares none.
+    softmax_mask_gen: jnp.ndarray | None = None
 
     @property
     def n_groups(self) -> int:
@@ -102,8 +105,14 @@ def make_codec(schema: FeatureSchema) -> Codec:
     n_groups = len(group_lists)
     group_ml_idx, group_pad_mask, group_sizes = _pad_group_tables(group_lists)
 
+    # int: integer genes + collapsed categorical (OHE) genes; softmax genes
+    # are continuous simplex members, neither int nor real-plain
     int_mask = np.array(
-        [types[i] != "real" for i in non_ohe_ml] + [True] * n_groups, dtype=bool
+        [types[i] == "int" for i in non_ohe_ml] + [True] * n_groups, dtype=bool
+    )
+    softmax_mask = np.array(
+        [types[i] == SOFTMAX_TYPE for i in non_ohe_ml] + [False] * n_groups,
+        dtype=bool,
     )
 
     return Codec(
@@ -115,6 +124,7 @@ def make_codec(schema: FeatureSchema) -> Codec:
         mutable_mask=jnp.asarray(np.asarray(mutable, dtype=bool)),
         n_features=schema.n_features,
         gen_length=len(non_ohe_ml) + n_groups,
+        softmax_mask_gen=jnp.asarray(softmax_mask),
     )
 
 

@@ -2,8 +2,12 @@
 
 Parses the ``features.csv`` / ``constraints.csv`` schema the reference defines
 (columns ``feature,type,mutable,min,max[,augmentation]``, type in
-{real, int, oheN}; min/max may be the literal string ``"dynamic"`` meaning the
-bound is resolved per input sample).
+{real, int, oheN, softmax}; min/max may be the literal string ``"dynamic"``
+meaning the bound is resolved per input sample). ``softmax`` marks genes that
+together form one probability simplex — the genetic operators renormalise the
+sub-vector after every crossover/mutation (the reference registers dedicated
+operators for this type, ``softmax_crossover.py:9-42``,
+``softmax_mutation.py:8-71``, though none of its shipped datasets use it).
 
 Reference parity: the provisioning logic of the per-use-case ``Constraints``
 subclasses (``/root/reference/src/examples/lcld/lcld_constraints.py:237-279``,
@@ -19,6 +23,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 OHE_PREFIX = "ohe"
+SOFTMAX_TYPE = "softmax"
 
 
 def _parse_bool(value: str) -> bool:
@@ -30,11 +35,29 @@ class FeatureSchema:
     """Static description of one tabular use case's feature space."""
 
     names: tuple
-    types: np.ndarray  # (D,) object: "real" | "int" | "ohe<N>"
+    types: np.ndarray  # (D,) object: "real" | "int" | "ohe<N>" | "softmax"
     mutable: np.ndarray  # (D,) bool
     raw_min: np.ndarray  # (D,) object: float or "dynamic"
     raw_max: np.ndarray  # (D,) object: float or "dynamic"
     augmentation: np.ndarray  # (D,) bool — augmented (derived XOR) feature flag
+
+    def __post_init__(self):
+        # The type strings are semantically load-bearing across independent
+        # consumers (codec genetics, MILP variable typing, PGD rounding): an
+        # unrecognised string must fail here, at load, not drift into
+        # contradictory per-consumer defaults.
+        import re
+
+        bad = [
+            (n, t)
+            for n, t in zip(self.names, self.types)
+            if not re.fullmatch(rf"real|int|{SOFTMAX_TYPE}|{OHE_PREFIX}\d+", str(t))
+        ]
+        if bad:
+            raise ValueError(
+                f"unknown feature type(s) {bad}; expected real, int, "
+                f"{SOFTMAX_TYPE}, or {OHE_PREFIX}<N>"
+            )
 
     @property
     def n_features(self) -> int:

@@ -173,7 +173,7 @@ class TestOperators:
 
     def test_tables(self):
         t = self._tables([False, True, False, True, True])
-        np.testing.assert_array_equal(np.asarray(t.type_sizes), [2, 3])
+        np.testing.assert_array_equal(np.asarray(t.type_sizes), [2, 3, 0])
         np.testing.assert_array_equal(np.asarray(t.rank_in_type), [0, 0, 1, 1, 2])
         np.testing.assert_allclose(
             np.asarray(t.mut_prob), [1 / 2, 1 / 3, 1 / 2, 1 / 3, 1 / 3]

@@ -1,0 +1,237 @@
+"""Softmax ("simplex") gene type: codec tables, operator renormalisation,
+and an end-to-end micro-attack.
+
+Reference parity: ``SoftmaxPointCrossover`` / ``SoftmaxPolynomialMutation``
+(``/root/reference/src/attacks/moeva2/softmax_{crossover,mutation}.py``) —
+dormant there (no shipped dataset declares the type), first-class here: a
+schema may type genes "softmax", and the operator stack keeps that sub-vector
+on the probability simplex (crossover renorm for crossed matings, mutation
+renorm for every row).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.moeva import operators
+from moeva2_ijcai22_replication_tpu.core.codec import make_codec
+from moeva2_ijcai22_replication_tpu.core.constraints import FunctionalConstraintSet
+from moeva2_ijcai22_replication_tpu.core.schema import FeatureSchema
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import MLP, init_params
+
+
+def _schema():
+    """2 real + 4 softmax + 1 int + one 2-member OHE group (9 features)."""
+    types = ["real", "real", "softmax", "softmax", "softmax", "softmax",
+             "int", "ohe0", "ohe0"]
+    n = len(types)
+    return FeatureSchema(
+        names=tuple(f"f{i}" for i in range(n)),
+        types=np.array(types, dtype=object),
+        mutable=np.ones(n, dtype=bool),
+        raw_min=np.array([0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0], dtype=object),
+        raw_max=np.array([1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 5.0, 1.0, 1.0], dtype=object),
+        augmentation=np.zeros(n, dtype=bool),
+    )
+
+
+SOFTMAX_GENES = slice(2, 6)  # genetic layout: non-OHE genes first, in order
+
+
+@pytest.fixture(scope="module")
+def codec():
+    return make_codec(_schema())
+
+
+@pytest.fixture(scope="module")
+def tables(codec):
+    return operators.make_operator_tables(codec)
+
+
+def _population(key, codec, n):
+    """Random valid genetic population: softmax genes on the simplex."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.uniform(k1, (n, codec.gen_length))
+    sm = np.asarray(codec.softmax_mask_gen)
+    simplex = jax.random.dirichlet(k2, jnp.ones(int(sm.sum())), (n,))
+    x = x.at[:, np.flatnonzero(sm)].set(simplex)
+    x = x.at[:, 6].set(jnp.round(x[:, 6] * 5))  # int gene
+    x = x.at[:, 7].set(jnp.round(jax.random.uniform(k3, (n,))))  # cat gene
+    return x
+
+
+class TestTables:
+    def test_codec_masks(self, codec):
+        # genetic layout: 7 non-OHE genes + 1 categorical group gene
+        assert codec.gen_length == 8
+        sm = np.asarray(codec.softmax_mask_gen)
+        assert np.flatnonzero(sm).tolist() == [2, 3, 4, 5]
+        # softmax genes are continuous: no integer rounding
+        assert not np.asarray(codec.int_mask_gen)[sm].any()
+        assert np.asarray(codec.int_mask_gen).tolist() == (
+            [False, False, False, False, False, False, True, True]
+        )
+
+    def test_type_families(self, tables):
+        assert tables.has_softmax
+        assert np.asarray(tables.type_sizes).tolist() == [2, 2, 4]
+        assert np.asarray(tables.type_id).tolist() == [0, 0, 2, 2, 2, 2, 1, 1]
+        # per-type mutation prob: 1/n_type (pymoo sub-problem contract)
+        np.testing.assert_allclose(
+            np.asarray(tables.mut_prob), [0.5, 0.5, 0.25, 0.25, 0.25, 0.25, 0.5, 0.5]
+        )
+
+    def test_no_softmax_schema_unchanged(self):
+        types = np.array(["real", "int"], dtype=object)
+        schema = FeatureSchema(
+            names=("a", "b"),
+            types=types,
+            mutable=np.ones(2, dtype=bool),
+            raw_min=np.array([0.0, 0.0], dtype=object),
+            raw_max=np.array([1.0, 5.0], dtype=object),
+            augmentation=np.zeros(2, dtype=bool),
+        )
+        t = operators.make_operator_tables(make_codec(schema))
+        assert not t.has_softmax
+        assert np.asarray(t.type_sizes).tolist() == [1, 1, 0]
+
+
+class TestOperatorsKeepSimplex:
+    def test_crossover_renormalises_crossed_matings(self, codec, tables):
+        key = jax.random.PRNGKey(0)
+        p1 = _population(jax.random.PRNGKey(1), codec, 128)
+        p2 = _population(jax.random.PRNGKey(2), codec, 128)
+        c1, c2 = operators.two_point_crossover(key, tables, p1, p2, prob=1.0)
+        for c in (np.asarray(c1), np.asarray(c2)):
+            s = c[:, SOFTMAX_GENES]
+            np.testing.assert_allclose(s.sum(1), 1.0, atol=1e-6)
+            assert (s > 0).all()
+            # non-softmax genes are pure swaps of parent genes
+            both = np.stack([np.asarray(p1)[:, :2], np.asarray(p2)[:, :2]])
+            assert np.all((c[:, :2] == both[0]) | (c[:, :2] == both[1]))
+
+    def test_crossover_prob_zero_copies_parents_verbatim(self, codec, tables):
+        p1 = _population(jax.random.PRNGKey(3), codec, 64)
+        p2 = _population(jax.random.PRNGKey(4), codec, 64)
+        c1, c2 = operators.two_point_crossover(
+            jax.random.PRNGKey(5), tables, p1, p2, prob=0.0
+        )
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(p1))
+        np.testing.assert_array_equal(np.asarray(c2), np.asarray(p2))
+
+    def test_mutation_renormalises_every_row(self, codec, tables):
+        x = _population(jax.random.PRNGKey(6), codec, 256)
+        xl = jnp.zeros(codec.gen_length)
+        xu = jnp.asarray([1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 5.0, 1.0])
+        y = np.asarray(
+            operators.polynomial_mutation(jax.random.PRNGKey(7), tables, x, xl, xu)
+        )
+        s = y[:, SOFTMAX_GENES]
+        np.testing.assert_allclose(s.sum(1), 1.0, atol=1e-6)
+        assert (s > 0).all()
+        # int gene still integral and in bounds
+        assert np.all(y[:, 6] == np.round(y[:, 6]))
+        assert y[:, 6].min() >= 0 and y[:, 6].max() <= 5
+
+    def test_renorm_helper_leaves_other_genes_alone(self, tables):
+        x = jnp.asarray(np.arange(16, dtype=float).reshape(2, 8))
+        y = np.asarray(operators.softmax_renorm(tables.softmax_mask, x))
+        np.testing.assert_array_equal(y[:, [0, 1, 6, 7]], np.asarray(x)[:, [0, 1, 6, 7]])
+        np.testing.assert_allclose(y[:, SOFTMAX_GENES].sum(1), 1.0, atol=1e-6)
+
+
+class TestOtherConsumers:
+    def test_schema_rejects_unknown_type(self):
+        with pytest.raises(ValueError, match="unknown feature type"):
+            FeatureSchema(
+                names=("a",),
+                types=np.array(["sofmax"], dtype=object),  # typo must fail at load
+                mutable=np.ones(1, dtype=bool),
+                raw_min=np.array([0.0], dtype=object),
+                raw_max=np.array([1.0], dtype=object),
+                augmentation=np.zeros(1, dtype=bool),
+            )
+
+    def test_pgd_rounding_skips_softmax_features(self):
+        from moeva2_ijcai22_replication_tpu.attacks.pgd.engine import (
+            round_ints_toward_initial,
+        )
+
+        schema = _schema()
+        x0 = np.array([[0.5, 0.5, 0.25, 0.25, 0.25, 0.25, 2.0, 1.0, 0.0]])
+        xa = np.array([[0.7, 0.5, 0.4, 0.2, 0.2, 0.2, 2.6, 0.4, 0.6]])
+        out = round_ints_toward_initial(xa, x0, schema.types)
+        # softmax block untouched (continuous simplex), int/ohe rounded
+        np.testing.assert_array_equal(out[0, 2:6], xa[0, 2:6])
+        assert out[0, 6] == 2.0  # int moved up -> floored
+        np.testing.assert_array_equal(out[0, 7:], [1.0, 0.0])
+
+    def test_sat_repair_keeps_simplex(self):
+        from moeva2_ijcai22_replication_tpu.attacks.sat.engine import (
+            LinearRows,
+            SatAttack,
+        )
+        from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+        schema = _schema()
+        cons = FunctionalConstraintSet(
+            schema,
+            fn=lambda x: jnp.abs(1.0 - x[..., 2:6].sum(-1))[..., None],
+            n_constraints=1,
+        )
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=lambda x, h: LinearRows(rows=[], fixes={}),
+            min_max_scaler=fit_minmax(
+                np.zeros(9), np.array([1, 1, 1, 1, 1, 1, 5, 1, 1.0])
+            ),
+            eps=0.5,
+            norm=np.inf,
+        )
+        x = np.array([[0.5, 0.5, 0.25, 0.25, 0.25, 0.25, 2.0, 1.0, 0.0]])
+        # hot start off the simplex: the engine's auto-derived Σ=1 row must
+        # pull the repair back onto it even with no domain rows at all
+        hot = np.array([[0.5, 0.5, 0.45, 0.45, 0.25, 0.25, 2.0, 1.0, 0.0]])
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        np.testing.assert_allclose(out[:, 2:6].sum(-1), 1.0, atol=1e-6)
+        assert cons.check_constraints_error(out) is None
+
+
+class TestEndToEnd:
+    def test_attack_keeps_softmax_population_on_simplex(self):
+        schema = _schema()
+        cons = FunctionalConstraintSet(
+            schema,
+            fn=lambda x: jnp.zeros(x.shape[:-1] + (1,)),
+            n_constraints=1,
+        )
+        model = MLP(hidden=(8,), n_classes=2)
+        sur = Surrogate(model, init_params(model, schema.n_features, seed=0))
+
+        codec = make_codec(schema)
+        x_gen = _population(jax.random.PRNGKey(8), codec, 3)
+        # ML space: genetic non-OHE genes map 1:1; expand the cat gene
+        x = np.zeros((3, schema.n_features))
+        x[:, :7] = np.asarray(x_gen)[:, :7]
+        x[:, 7] = (np.asarray(x_gen)[:, 7] == 0).astype(float)
+        x[:, 8] = (np.asarray(x_gen)[:, 7] == 1).astype(float)
+
+        moeva = Moeva2(
+            classifier=sur,
+            constraints=cons,
+            norm=2,
+            n_gen=5,
+            n_pop=12,
+            n_offsprings=6,
+            seed=9,
+            dtype=jnp.float64,
+        )
+        res = moeva.generate(x, minimize_class=1)
+        s = res.x_ml[..., 2:6]
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-6)
+        assert (s >= 0).all()
+        # the evolved populations actually moved
+        assert not np.allclose(res.x_ml, np.broadcast_to(x[:, None, :], res.x_ml.shape))

@@ -16,8 +16,11 @@ fidelity limits vs the reference:
   *modes*: a builder may append auxiliary binary variables (``n_extra_bin``)
   and big-M rows — LCLD's term ∈ {36, 60} amortisation switch is a genuine
   mode search, matching the reference's indicator+pow constraints
-  (``lcld_constraints_sat.py:25-36``). Continuous nonlinear participants
-  (ratio denominators, dates) remain pinned at hot-start values, with every
+  (``lcld_constraints_sat.py:25-36``), and LCLD's mutable ratio denominators
+  are grid-searched over the ε-box (``domains/lcld_sat.py``). Builders
+  accepting a third parameter receive the ε-intersected feature box for
+  exactly this purpose. Immutable nonlinear participants (dates, pub_rec)
+  are pinned at hot-start values — exact by immutability — with every
   zero/degenerate pin detected and mapped to the infeasible fallback.
 - The L2 ε-ball (Gurobi pow-constraint, ``sat.py:98-124``) is inscribed by
   a per-feature box with Σ radius² = ε² — solutions remain valid L2
@@ -72,7 +75,8 @@ class LinearRows:
 @dataclass
 class SatAttack:
     constraints: ConstraintSet
-    sat_rows_builder: Callable[[np.ndarray, np.ndarray], LinearRows]
+    #: (x_init, hot, box) -> LinearRows, box = the ε-intersected (xl, xu)
+    sat_rows_builder: Callable[[np.ndarray, np.ndarray, tuple], LinearRows]
     min_max_scaler: MinMaxParams
     eps: float
     norm: Any = np.inf
@@ -152,7 +156,9 @@ class SatAttack:
         xl[~self._mutable] = x_init[~self._mutable]
         xu[~self._mutable] = x_init[~self._mutable]
 
-        spec = self.sat_rows_builder(x_init, hot)
+        # builders receive the ε-intersected feature box so they can
+        # grid-search nonlinear participants inside it
+        spec = self.sat_rows_builder(x_init, hot, (xl.copy(), xu.copy()))
         if not spec.feasible:
             return np.tile(x_init, (self.n_sample, 1))
         if len(self._softmax_idx):

@@ -44,7 +44,11 @@ def make_botnet_sat_builder(constraints: BotnetConstraints):
         for lo, up in zip(np.asarray(lo_idx), np.asarray(up_idx)):
             static_rows.append(([int(lo), int(up)], [1.0, -1.0], -np.inf, 0.0))
 
-    def build(x_init: np.ndarray, hot: np.ndarray) -> LinearRows:
+    def build(
+        x_init: np.ndarray, hot: np.ndarray, box: tuple | None = None
+    ) -> LinearRows:
+        # box unused: every botnet constraint is already linear, nothing to
+        # grid-search (the builder protocol passes it to all domains)
         return LinearRows(rows=static_rows, fixes={})
 
     return build

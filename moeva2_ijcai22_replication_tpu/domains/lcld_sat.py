@@ -12,16 +12,22 @@ HiGHS formulation:
   matching amortisation equality |installment − c_t·loan_amnt| ≤ 0.0999
   (int_rate is immutable, so both c_36 and c_60 are constants — the
   (1+r)^term power never has to live inside the MILP).
-- the ratio denominators annual_inc, total_acc, pub_rec and both date
-  features are pinned at hot-start values, so g5/g6/g8/g9/g10 are linear and
-  g7 fixes the month-difference feature to a constant. The pins on issue_d,
-  earliest_cr_line and pub_rec are **exact** (those features are immutable
-  in the schema, so every attack leaves them at the initial value anyway);
-  the only genuine search-power loss vs the reference's nonconvex bilinear
-  rows is the two mutable denominators annual_inc and total_acc. Every pin
-  that lands on a zero denominator (annual_inc, total_acc, or a zero month
-  difference) makes the corresponding equality unsatisfiable — the builder
-  flags the program infeasible instead of emitting inf coefficients.
+- **the mutable ratio denominators annual_inc and total_acc are searched,
+  not pinned**: each gets a grid of candidate values over its ε-box (always
+  including the hot-start and initial values, so results are never worse
+  than a pin) selected by one-hot binaries — the denominator variable is the
+  exact linear combination Σ vₖ·zₖ, and each mode's ratio equality
+  (g5: ratio = loan/annual_inc, g6: ratio = open/total) activates through
+  big-M rows with benign magnitudes. This is the same mode-search
+  architecture as the term switch; the reference instead hands Gurobi the
+  nonconvex bilinear rows directly (``NonConvex=2``), so its search is
+  continuous where ours is gridded — the documented residual gap.
+- pub_rec and both date features are pinned at hot-start values — **exact**
+  pins, those features are immutable in the schema — so g7 fixes the
+  month-difference feature and g8/g9/g10 are linear. A zero month
+  difference (or an all-zero denominator grid) makes the corresponding
+  equality unsatisfiable — the builder flags the program infeasible instead
+  of emitting inf coefficients.
 - one-hot groups: integral 0/1 members summing to 1.
 
 The MILP searches term, loan_amnt, installment, open_acc,
@@ -49,11 +55,28 @@ def _amortisation_factor(rate_pct: float, term: float) -> float:
     return r * growth / (growth - 1.0)
 
 
+def _denominator_grid(
+    hot_v: float, init_v: float, lo: float, hi: float, n: int = 5
+) -> list:
+    """Candidate pins for a searched ratio denominator: hot-start and initial
+    values (never worse than the old single pin) plus an n-point spread over
+    the ε-box; zeros and out-of-box values dropped, near-duplicates merged."""
+    cand = [float(hot_v), float(init_v)] + list(np.linspace(lo, hi, n))
+    cand = [v for v in cand if lo - 1e-12 <= v <= hi + 1e-12 and v != 0.0]
+    out: list = []
+    for v in sorted(cand):
+        if not out or abs(v - out[-1]) > 1e-9 * max(1.0, abs(v)):
+            out.append(v)
+    return out
+
+
 def make_lcld_sat_builder(schema: FeatureSchema):
     ohe_groups = [np.asarray(g) for g in schema.ohe_groups()]
     d = schema.n_features
 
-    def build(x_init: np.ndarray, hot: np.ndarray) -> LinearRows:
+    def build(
+        x_init: np.ndarray, hot: np.ndarray, box: tuple | None = None
+    ) -> LinearRows:
         rows = []
         fixes = {}
 
@@ -81,22 +104,63 @@ def make_lcld_sat_builder(schema: FeatureSchema):
         rows.append(([10, 14], [1.0, -1.0], -np.inf, 0.0))
         rows.append(([16, 11], [1.0, -1.0], -np.inf, 0.0))
 
-        # pin the nonlinear participants at hot-start values
-        fixes[6] = hot[6]  # annual_inc (g5 denominator)
-        fixes[14] = hot[14]  # total_acc (g6 denominator)
+        # exact pins: issue_d / earliest_cr_line / pub_rec are immutable, so
+        # the hot-start value IS the only admissible value
         fixes[7] = hot[7]  # issue_d (g7 months)
         fixes[9] = hot[9]  # earliest_cr_line (g7 months)
         fixes[11] = hot[11]  # pub_rec (g3/g8/g10 denominator)
         diff = float(_months(fixes[7]) - _months(fixes[9]))
-        # zero pinned denominators make g5/g6/g8/g9 unsatisfiable — flag
-        # infeasible rather than emitting inf coefficients
-        if fixes[6] == 0 or fixes[14] == 0 or diff == 0:
+        if diff == 0:  # g8/g9 unsatisfiable: zero month difference
             return LinearRows(rows=[], fixes={}, feasible=False)
 
-        # g5: ratio_loan_income == loan / annual_inc
-        rows.append(([20, 0], [1.0, -1.0 / fixes[6]], -SLACK, SLACK))
+        # g5/g6: mutable denominators searched over a candidate grid. For a
+        # denominator feature j with grid v_1..v_K and one-hot binaries z_k:
+        #   x_j = Σ v_k z_k  (exact linear selection),  Σ z_k = 1,
+        #   |ratio − numerator / v_k| ≤ SLACK + M_k (1 − z_k)  per mode.
+        if box is not None:
+            box_lo, box_hi = np.asarray(box[0]), np.asarray(box[1])
+        else:  # standalone callers without a box: search hot ∪ init only
+            box_lo = np.minimum(x_init, hot)
+            box_hi = np.maximum(x_init, hot)
+        n_bin = 1  # the term binary z at index d
+
+        def denominator_modes(den: int, ratio: int, num_cols, num_coefs, num_hi):
+            """Append mode-search rows for ratio == numerator / x_den, where
+            the numerator is the linear form num_cols·num_coefs (|·| ≤ num_hi).
+            Returns False when no admissible denominator value exists."""
+            nonlocal n_bin
+            grid = _denominator_grid(hot[den], x_init[den], box_lo[den], box_hi[den])
+            if not grid:
+                return False
+            base = d + n_bin
+            n_bin += len(grid)
+            zs = list(range(base, base + len(grid)))
+            rows.append((zs, np.ones(len(grid)), 1.0, 1.0))  # Σ z_k = 1
+            rows.append(  # x_den = Σ v_k z_k
+                ([den] + zs, np.concatenate([[1.0], -np.asarray(grid)]), 0.0, 0.0)
+            )
+            for v, z_k in zip(grid, zs):
+                big = (
+                    max(abs(xu_s[ratio]), abs(xl_s[ratio]))
+                    + num_hi / abs(v)
+                    + 1.0
+                )
+                coefs = [1.0] + [-c / v for c in num_coefs]
+                rows.append(
+                    (([ratio] + list(num_cols) + [z_k]), coefs + [big], -np.inf, SLACK + big)
+                )
+                rows.append(
+                    (([ratio] + list(num_cols) + [z_k]), coefs + [-big], -SLACK - big, np.inf)
+                )
+            return True
+
+        # g5: ratio_loan_income == loan_amnt / annual_inc
+        ok5 = denominator_modes(6, 20, [0], [1.0], max(abs(xu_s[0]), abs(xl_s[0])))
         # g6: ratio_open_total == open_acc / total_acc
-        rows.append(([21, 10], [1.0, -1.0 / fixes[14]], -SLACK, SLACK))
+        ok6 = denominator_modes(14, 21, [10], [1.0], max(abs(xu_s[10]), abs(xl_s[10])))
+        if not (ok5 and ok6):  # every candidate denominator was zero/out-of-box
+            return LinearRows(rows=[], fixes={}, feasible=False)
+
         # g7: month difference fixed by the pinned dates
         fixes[22] = diff
         # g8/g9: ratios over the (constant) month difference
@@ -113,6 +177,6 @@ def make_lcld_sat_builder(schema: FeatureSchema):
         for g in ohe_groups:
             rows.append((g, np.ones(len(g)), 1.0, 1.0))
 
-        return LinearRows(rows=rows, fixes=fixes, n_extra_bin=1)
+        return LinearRows(rows=rows, fixes=fixes, n_extra_bin=n_bin)
 
     return build

@@ -289,12 +289,32 @@ class TestLcldModeSearchAndPool:
         # every pool member is constraint-valid
         cons.check_constraints_error(out.reshape(-1, x.shape[1]))
 
-    def test_zero_total_acc_pin_falls_back(self, lcld_setup):
+    def test_zero_total_acc_hot_start_recovers(self, lcld_setup):
+        """A zero hot-start denominator must not poison the program: the
+        grid search drops the zero candidate (no inf coefficient) and still
+        finds a valid repair from the remaining candidates — stronger than
+        the old pin semantics, which could only fall back to x_init."""
         cons, x, scaler = lcld_setup
         hot = x.copy()
-        hot[:, 14] = 0.0  # g6 denominator — must not become an inf coefficient
+        hot[:, 14] = 0.0  # g6 denominator
         out = self._attack(cons, scaler).generate(x, hot_start=hot)[:, 0, :]
-        np.testing.assert_allclose(out, x)
+        cons.check_constraints_error(out)
+        assert (out[:, 14] != 0).all()
+
+    def test_denominator_mode_search_tracks_hot_start(self, lcld_setup):
+        """annual_inc is searched, not pinned: with a hot start whose
+        annual_inc moved and whose ratio is consistent, the MILP selects the
+        hot-start grid candidate instead of snapping back to x_init."""
+        cons, x, scaler = lcld_setup
+        hot = x.copy()
+        hot[:, 6] = x[:, 6] * 1.2
+        hot[:, 20] = hot[:, 0] / hot[:, 6]
+        out = self._attack(cons, scaler).generate(x, hot_start=hot)[:, 0, :]
+        cons.check_constraints_error(out)
+        np.testing.assert_allclose(out[:, 6], hot[:, 6], rtol=1e-6)
+        # the old pin-at-hot behaviour also satisfied this; the searched
+        # version must in addition keep the consistent ratio
+        np.testing.assert_allclose(out[:, 20], hot[:, 20], atol=2e-4)
 
     def test_zero_month_diff_pin_falls_back(self, lcld_setup):
         cons, x, scaler = lcld_setup

@@ -184,7 +184,7 @@ class TestOtherConsumers:
         )
         atk = SatAttack(
             constraints=cons,
-            sat_rows_builder=lambda x, h: LinearRows(rows=[], fixes={}),
+            sat_rows_builder=lambda x, h, box: LinearRows(rows=[], fixes={}),
             min_max_scaler=fit_minmax(
                 np.zeros(9), np.array([1, 1, 1, 1, 1, 1, 5, 1, 1.0])
             ),

@@ -323,6 +323,11 @@ def _survive_post(key, f, ranks, niche, dist, n_dirs, n_survive):
     Cumulative front sizes as (M, M) comparison matmuls: scatter-add
     histograms are the asymptotically cheaper formulation but lose badly
     to the MXU on TPU at these shapes (measured 2x slower end-to-end).
+    A sort-based O(M log M) formulation (sorted ranks + searchsorted for the
+    cumulative counts, double stable argsort for the within-niche ranking)
+    was also measured bit-identical but ~12x slower at bench shapes — TPU
+    sorts are bitonic multi-pass kernels, while the M² comparisons fuse into
+    single MXU-friendly reductions. Keep the matmuls.
     """
     m = f.shape[0]
     one = jnp.ones((m,), jnp.int32)

@@ -361,12 +361,6 @@ class Moeva2:
                 f"x has {x.shape[1]} features, schema expects {self.codec.n_features}"
             )
         s = x.shape[0]
-        if self.mesh is not None and s % self.mesh.size != 0:
-            raise ValueError(
-                f"n_states={s} must be divisible by the mesh size "
-                f"{self.mesh.size} to shard the states axis; pad the "
-                "candidate set or trim it to a multiple"
-            )
         if isinstance(minimize_class, (int, np.integer)):
             minimize_class = np.full((s,), int(minimize_class))
         minimize_class = np.asarray(minimize_class)
@@ -548,18 +542,10 @@ class Moeva2:
 
     def _shard_args(self, args):
         """Shard the states axis over the mesh; replicate params/key."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..sharding import shard_states_args
 
-        mesh = self.mesh
-        state_sh = NamedSharding(mesh, P(self.states_axis))
-        repl = NamedSharding(mesh, P())
         params, x, mc, xl, xu, key = args
-        put = jax.device_put
-        return (
-            jax.tree.map(lambda a: put(a, repl), params),
-            put(x, state_sh),
-            put(mc, state_sh),
-            put(xl, state_sh),
-            put(xu, state_sh),
-            put(key, repl),
+        (params, key), (x, mc, xl, xu) = shard_states_args(
+            self.mesh, self.states_axis, (params, key), (x, mc, xl, xu)
         )
+        return (params, x, mc, xl, xu, key)

@@ -65,6 +65,11 @@ class ConstrainedPGD:
     #: grad-norm stream, ``atk.py:201-226``) as an extra column after
     #: cons_sum and before any "full" per-constraint columns.
     record_grad_norm: bool = False
+    #: shard the batch (states) axis over a device mesh. Every op in the
+    #: attack is per-sample, so XLA partitions the whole fori_loop with zero
+    #: collectives — the same data-parallel axis as the MoEvA engine's.
+    mesh: jax.sharding.Mesh | None = None
+    states_axis: str = "states"
 
     def __post_init__(self):
         self._mutable = jnp.asarray(
@@ -278,12 +283,21 @@ class ConstrainedPGD:
         """Attack scaled candidates ``x_scaled`` with true labels ``y``."""
         if self._jit_attack is None:
             self._jit_attack = jax.jit(self._build())
-        out, hist = self._jit_attack(
+        args = (
             self.classifier.params,
             jnp.asarray(x_scaled, self.dtype),
             jnp.asarray(y, jnp.int32),
             jax.random.PRNGKey(self.seed),
         )
+        if self.mesh is not None:
+            from ..sharding import shard_states_args
+
+            params, x_dev, y_dev, key = args
+            (params, key), (x_dev, y_dev) = shard_states_args(
+                self.mesh, self.states_axis, (params, key), (x_dev, y_dev)
+            )
+            args = (params, x_dev, y_dev, key)
+        out, hist = self._jit_attack(*args)
         # (N, max_iter, C) — runners add the reference's unit axis on save
         # (01_pgd_united.py:196-199).
         self.loss_history = (

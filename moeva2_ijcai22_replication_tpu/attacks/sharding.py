@@ -1,0 +1,38 @@
+"""States-axis data-parallel sharding shared by the attack engines.
+
+Both attack families scale the same way (SURVEY §2.8): initial states are
+embarrassingly parallel, so the batch axis shards over a 1-D device mesh with
+zero collectives in the hot loop. This module owns the divisibility contract
+and the replicate/shard placements so the engines cannot drift; runners that
+face data-dependent candidate counts pad to a mesh multiple with
+:func:`..experiments.common.pad_states` and trim afterwards.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_states_args(mesh, states_axis: str, replicated: tuple, sharded: tuple):
+    """Place arrays for a states-sharded attack dispatch.
+
+    ``replicated`` pytrees (params, PRNG keys) land fully replicated;
+    ``sharded`` arrays split their leading axis over ``states_axis``.
+    Returns ``(replicated, sharded)`` with the same structures.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_states = sharded[0].shape[0]
+    if n_states % mesh.size != 0:
+        raise ValueError(
+            f"n_states={n_states} must be divisible by the mesh size "
+            f"{mesh.size} to shard the states axis; pad the batch or trim "
+            "it to a multiple (runners: experiments.common.pad_states)"
+        )
+    state_sh = NamedSharding(mesh, P(states_axis))
+    repl = NamedSharding(mesh, P())
+    rep_out = tuple(
+        jax.tree.map(lambda a: jax.device_put(a, repl), r) for r in replicated
+    )
+    sh_out = tuple(jax.device_put(a, state_sh) for a in sharded)
+    return rep_out, sh_out

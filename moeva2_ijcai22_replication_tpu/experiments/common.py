@@ -110,3 +110,18 @@ def build_mesh(config: dict):
             )
         devices = devices[:n]
     return Mesh(np.array(devices), ("states",))
+
+
+def pad_states(x: np.ndarray, mesh) -> tuple[np.ndarray, int]:
+    """Pad the leading (states) axis to a mesh-size multiple.
+
+    Candidate counts are data-dependent (e.g. the 387-row botnet set), so
+    runners pad with copies of the last row before a mesh-sharded attack and
+    trim every per-state result back to ``n_orig`` rows afterwards. Returns
+    ``(x_padded, n_orig)``; a no-op without a mesh or when already aligned.
+    """
+    n = x.shape[0]
+    if mesh is None or n % mesh.size == 0:
+        return x, n
+    pad = (-n) % mesh.size
+    return np.concatenate([x, np.repeat(x[-1:], pad, axis=0)]), n

@@ -108,11 +108,7 @@ def moeva_attack(model, constraints, ml_scaler, config, x_cand) -> np.ndarray:
     """MoEvA over internally-computed candidates; pads the states axis to the
     mesh size (candidate counts are data-dependent) and trims the result."""
     mesh = common.build_mesh(config)
-    n = x_cand.shape[0]
-    x_run = x_cand
-    if mesh is not None and n % mesh.size != 0:
-        pad = (-n) % mesh.size
-        x_run = np.concatenate([x_cand, np.repeat(x_cand[-1:], pad, axis=0)])
+    x_run, n = common.pad_states(x_cand, mesh)
     result = Moeva2(
         classifier=model, constraints=constraints, ml_scaler=ml_scaler,
         norm=config["norm"], n_gen=config["budget"],
@@ -376,11 +372,14 @@ def run(config: dict) -> dict:
             # :358-364); botnet runs the untargeted variant (:361-366).
             targeted=knobs["gradient_model"],
             seed=config["seed"],
+            mesh=common.build_mesh(config),
         )
-        y_att = np.zeros(x_cand.shape[0], dtype=np.int64)
+        # candidate counts are data-dependent: pad to a mesh multiple, trim
+        x_run, n_orig = common.pad_states(np.asarray(x_cand), pgd.mesh)
+        y_att = np.zeros(x_run.shape[0], dtype=np.int64)
         x_att = np.asarray(
-            ml_scaler.inverse(pgd.generate(ml_scaler.transform(x_cand), y_att))
-        )
+            ml_scaler.inverse(pgd.generate(ml_scaler.transform(x_run), y_att))
+        )[:n_orig]
         x_att = round_ints_toward_initial(
             x_att, x_cand, constraints.get_feature_type()
         )

@@ -74,11 +74,13 @@ def run(config: dict):
     with timer.phase("attack"), maybe_profile(
         config.get("system", {}).get("profile_dir")
     ):
-        result = moeva.generate(x_initial_states, 1)
+        # candidate counts are data-dependent: pad to a mesh multiple, trim
+        x_run, n_orig = common.pad_states(x_initial_states, moeva.mesh)
+        result = moeva.generate(x_run, 1)
     consumed_time = time.time() - start_time
 
     # ----- Persist populations ((S, P, D) ndarray — results_to_numpy_results)
-    x_attacks = result.x_ml
+    x_attacks = result.x_ml[:n_orig]
     if config.get("reconstruction"):
         # Strip the stale augmented columns and recompute them from the
         # attacked base features (04_moeva.py:97-104).
@@ -93,7 +95,7 @@ def run(config: dict):
         # (n_gen-1, S, n_off, C) per-generation objective history
         np.save(
             f"{out_dir}/x_history_{mid_fix}_{config_hash}.npy",
-            np.stack(result.history[1:]),
+            np.stack(result.history[1:])[:, :n_orig],
         )
 
     # ----- Success rates per ε (04_moeva.py:112-131)

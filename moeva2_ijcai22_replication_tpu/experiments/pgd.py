@@ -65,6 +65,7 @@ def run(config: dict):
         seed=config["seed"],
         record_loss=config.get("save_history") or None,
         record_grad_norm=bool(config.get("save_grad_norm")),
+        mesh=common.build_mesh(config),
     )
     if cls is AutoPGD:
         # AutoPGD defaults (01_pgd_united.py:99-111)
@@ -86,7 +87,12 @@ def run(config: dict):
         # ART infers labels from the classifier's own predictions when no y
         # is given (the reference calls generate(x) label-free).
         y = np.asarray(surrogate.predict_proba(x_scaled)).argmax(-1)
-        x_adv_scaled = attack.generate(x_scaled, y)
+        # candidate counts are data-dependent: pad to a mesh multiple, trim
+        x_run, n_orig = common.pad_states(x_scaled, attack.mesh)
+        y_run, _ = common.pad_states(y, attack.mesh)
+        x_adv_scaled = attack.generate(x_run, y_run)[:n_orig]
+        if attack.loss_history is not None:
+            attack.loss_history = attack.loss_history[:n_orig]
         x_attacks = np.asarray(scaler.inverse(x_adv_scaled))
 
         # Directional integer rounding (01_pgd_united.py:130-137).

@@ -315,3 +315,39 @@ class TestRunAll:
         assert kinds == ["rq"] * 6 + ["moeva"] * 2 + ["rq"] * 4
         # every grid carried its project list; rq4 points are moeva attacks
         assert all(p for k, p in calls if k == "rq")
+
+
+class TestMeshPadding:
+    """Data-dependent candidate counts (e.g. the 387-row botnet set) must not
+    crash mesh-sharded runs: runners pad the states axis to a mesh multiple
+    and trim every per-state artifact back."""
+
+    def test_moeva_runner_pads_indivisible_candidates(self, artifacts, tmp_path):
+        cfg = base_config(
+            artifacts, tmp_path / "out", n_initial_state=5, save_history="reduced"
+        )
+        cfg["system"] = {"n_jobs": 1, "verbose": 0, "mesh_devices": -1}
+        metrics = moeva_runner.run(cfg)
+        assert metrics is not None
+        h = get_dict_hash(cfg)
+        x_att = np.load(tmp_path / "out" / f"x_attacks_moeva_{h}.npy")
+        assert x_att.shape[0] == 5
+        hist = np.load(tmp_path / "out" / f"x_history_moeva_{h}.npy")
+        assert hist.shape[1] == 5
+
+    def test_pgd_runner_pads_indivisible_candidates(self, artifacts, tmp_path):
+        cfg = base_config(
+            artifacts,
+            tmp_path / "out",
+            attack_name="pgd",
+            budget=3,
+            n_initial_state=5,
+        )
+        cfg["system"] = {"n_jobs": 1, "verbose": 0, "mesh_devices": -1}
+        cfg["eps"] = 0.2
+        cfg["loss_evaluation"] = "flip"
+        metrics = pgd_runner.run(cfg)
+        assert metrics is not None
+        h = get_dict_hash(cfg)
+        x_att = np.load(tmp_path / "out" / f"x_attacks_pgd_flip_{h}.npy")
+        assert x_att.shape[0] == 5

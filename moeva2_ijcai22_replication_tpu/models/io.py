@@ -85,6 +85,8 @@ def load_keras_model(path: str) -> Surrogate:
 
 def load_classifier(path: str) -> Surrogate:
     """Dispatch on artifact type (parity: ``in_out.load_model``)."""
+    if path.rstrip("/").endswith(".orbax"):
+        return load_orbax(path)
     if path.endswith(".model") or os.path.isdir(path):
         return load_keras_model(path)
     if path.endswith((".msgpack", ".flax")):
@@ -92,12 +94,49 @@ def load_classifier(path: str) -> Surrogate:
     raise ValueError(f"Unknown model artifact: {path}")
 
 
+def _topology_meta(surrogate: Surrogate) -> np.ndarray:
+    """Topology header shared by every params format: hidden sizes then
+    n_classes, one int64 vector."""
+    return np.array(
+        list(surrogate.model.hidden) + [surrogate.model.n_classes], dtype=np.int64
+    )
+
+
+def save_orbax(surrogate: Surrogate, path: str) -> None:
+    """Orbax checkpoint of the surrogate (SURVEY §5's suggested TPU-native
+    model format; directory path, conventionally ``*.orbax``).
+
+    Same content as :func:`save_params` (topology meta + params pytree) in
+    the ecosystem-standard format — multi-host-safe, shard-aware, and
+    readable by any orbax consumer without this package.
+    """
+    import orbax.checkpoint as ocp
+
+    meta = _topology_meta(surrogate)
+    # StandardCheckpointer saves asynchronously: the context manager joins
+    # the background write before returning, so the artifact is durable
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            os.path.abspath(path),
+            {"meta": meta, "params": surrogate.params},
+            force=True,
+        )
+
+
+def load_orbax(path: str) -> Surrogate:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        raw = ckptr.restore(os.path.abspath(path))
+    meta = np.asarray(raw["meta"])
+    hidden, n_classes = tuple(int(v) for v in meta[:-1]), int(meta[-1])
+    return Surrogate(model=MLP(hidden=hidden, n_classes=n_classes), params=raw["params"])
+
+
 def save_params(surrogate: Surrogate, path: str) -> None:
     from flax import serialization
 
-    meta = np.array(
-        list(surrogate.model.hidden) + [surrogate.model.n_classes], dtype=np.int64
-    )
+    meta = _topology_meta(surrogate)
     with open(path, "wb") as f:
         np.save(f, meta, allow_pickle=False)
         f.write(serialization.to_bytes(surrogate.params))

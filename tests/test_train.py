@@ -126,3 +126,26 @@ class TestDataParallelMesh:
         a = np.asarray(single.surrogate.predict_proba(jnp.asarray(x)))
         b = np.asarray(dp.surrogate.predict_proba(jnp.asarray(x)))
         np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestOrbaxCheckpoint:
+    def test_roundtrip_and_dispatch(self, tmp_path):
+        """Orbax params checkpoint (SURVEY §5's suggested TPU-native model
+        format): save → load via both the io dispatcher and the generic
+        load_model entry point, bitwise-equal forward passes."""
+        from moeva2_ijcai22_replication_tpu.models.io import (
+            Surrogate, load_classifier, save_orbax,
+        )
+        from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+        from moeva2_ijcai22_replication_tpu.utils.in_out import load_model
+
+        model = lcld_mlp()
+        sur = Surrogate(model, init_params(model, 47, seed=1))
+        path = str(tmp_path / "nn.orbax")
+        save_orbax(sur, path)
+
+        x = jnp.asarray(np.random.default_rng(0).uniform(size=(5, 47)))
+        want = np.asarray(sur.predict_proba(x))
+        for loaded in (load_classifier(path), load_model(path)):
+            assert loaded.model.hidden == model.hidden
+            np.testing.assert_array_equal(np.asarray(loaded.predict_proba(x)), want)

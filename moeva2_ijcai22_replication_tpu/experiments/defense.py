@@ -36,7 +36,7 @@ from ..attacks.moeva import Moeva2
 from ..attacks.objective import ObjectiveCalculator
 from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
 from ..domains import augmentation
-from ..models.io import Surrogate, load_classifier, save_params
+from ..models.io import Surrogate, load_classifier, save_classifier
 from ..models.mlp import MLP, botnet_mlp, lcld_mlp
 from ..models.scalers import from_sklearn_minmax
 from ..models.train import auroc, fit_mlp
@@ -76,7 +76,7 @@ def _memo_model(path, fn) -> Surrogate:
         print(f"{path} exists loading...")
         return load_classifier(path)
     sur = fn()
-    save_params(sur, path)
+    save_classifier(sur, path)  # format follows the path's suffix
     return sur
 
 

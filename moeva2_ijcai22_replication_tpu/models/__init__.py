@@ -1,6 +1,13 @@
 from .mlp import MLP, lcld_mlp, botnet_mlp, forward_logits, predict_proba
 from .scalers import MinMaxParams, from_sklearn_minmax, load_joblib_scaler
-from .io import load_classifier, save_params, load_params
+from .io import (
+    load_classifier,
+    save_classifier,
+    save_params,
+    load_params,
+    save_orbax,
+    load_orbax,
+)
 
 __all__ = [
     "MLP",
@@ -12,6 +19,9 @@ __all__ = [
     "from_sklearn_minmax",
     "load_joblib_scaler",
     "load_classifier",
+    "save_classifier",
     "save_params",
     "load_params",
+    "save_orbax",
+    "load_orbax",
 ]

@@ -94,6 +94,17 @@ def load_classifier(path: str) -> Surrogate:
     raise ValueError(f"Unknown model artifact: {path}")
 
 
+def save_classifier(surrogate: Surrogate, path: str) -> None:
+    """Save-side counterpart of :func:`load_classifier`: dispatch on the
+    same suffix convention so a memoized artifact always reloads with the
+    format it was written in (``.orbax`` -> orbax directory, anything else
+    -> flax msgpack)."""
+    if path.rstrip("/").endswith(".orbax"):
+        save_orbax(surrogate, path)
+    else:
+        save_params(surrogate, path)
+
+
 def _topology_meta(surrogate: Surrogate) -> np.ndarray:
     """Topology header shared by every params format: hidden sizes then
     n_classes, one int64 vector."""

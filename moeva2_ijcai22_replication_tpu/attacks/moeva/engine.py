@@ -19,8 +19,7 @@ inter-device collectives in the hot loop.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any
 
 import jax

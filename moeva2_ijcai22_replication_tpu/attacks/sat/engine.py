@@ -48,7 +48,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ...core.constraints import ConstraintSet
-from ...core.norms import is_inf, is_l2, validate_norm
+from ...core.norms import is_inf, validate_norm
 from ...models.scalers import MinMaxParams
 
 SAFETY_DELTA = 1e-7  # sat.py:18

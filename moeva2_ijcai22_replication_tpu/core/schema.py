@@ -18,7 +18,7 @@ input mirrors ``get_feature_min_max(dynamic_input)``.
 from __future__ import annotations
 
 import csv
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -37,7 +37,7 @@ from ..attacks.objective import ObjectiveCalculator
 from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
 from ..domains import augmentation
 from ..models.io import Surrogate, load_classifier, save_classifier
-from ..models.mlp import MLP, botnet_mlp, lcld_mlp
+from ..models.mlp import botnet_mlp, lcld_mlp
 from ..models.scalers import from_sklearn_minmax
 from ..models.train import auroc, fit_mlp
 from ..utils.config import parse_config

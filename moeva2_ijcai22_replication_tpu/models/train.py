@@ -10,7 +10,6 @@ device mesh (data parallel; XLA inserts the gradient psums).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
 
 import jax
 import jax.numpy as jnp

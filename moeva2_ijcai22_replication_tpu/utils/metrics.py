@@ -67,3 +67,32 @@ def records(results_dir: str, pattern: str = "metrics_*.json") -> Iterator[dict]
     for path in sorted(glob.glob(os.path.join(results_dir, pattern))):
         with open(path) as fh:
             yield from iter_records(json.load(fh))
+
+
+def main(argv=None):
+    """``python -m ...utils.metrics <results_dir> [...]`` — print the flat
+    success-rate table for one or more results directories (the post-hoc
+    step the reference leaves to ad-hoc notebooks over its flattener)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("dirs", nargs="+", help="results directories to scan")
+    args = ap.parse_args(argv)
+
+    rows = [r for d in args.dirs for r in records(d)]
+    if not rows:
+        print("no metrics files found")
+        return
+    cols = ["project_name", "attack_name", "budget", "n_state", "eps", "time"]
+    header = cols + [f"o{i}" for i in range(1, 8)]
+    table = [header] + [
+        [f"{v:.4f}" if isinstance(v, float) else str(v) for v in (r.get(c) for c in header)]
+        for r in rows
+    ]
+    widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+    for row in table:
+        print("  ".join(cell.rjust(w) for cell, w in zip(row, widths)))
+
+
+if __name__ == "__main__":
+    main()

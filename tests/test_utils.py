@@ -111,3 +111,23 @@ class TestExperimentStream:
         with ExperimentStream(p, enabled=False) as s:
             s.log_metric("x", 1)
         assert not (tmp_path / "off.jsonl").exists()
+
+
+class TestMetricsCli:
+    def test_prints_one_row_per_record(self, tmp_path, capsys):
+        from moeva2_ijcai22_replication_tpu.utils.metrics import main
+
+        stream = TestMetricsRecords()
+        with open(tmp_path / "metrics_moeva_abc.json", "w") as f:
+            json.dump(stream._moeva_metrics(), f)
+        main([str(tmp_path)])
+        out = capsys.readouterr().out.strip().splitlines()
+        recs = list(records(str(tmp_path)))
+        assert len(out) == 1 + len(recs)  # header + rows
+        assert "o7" in out[0] and "attack_name" in out[0]
+
+    def test_empty_dir_reports_cleanly(self, tmp_path, capsys):
+        from moeva2_ijcai22_replication_tpu.utils.metrics import main
+
+        main([str(tmp_path)])
+        assert "no metrics files" in capsys.readouterr().out

@@ -138,6 +138,9 @@ def run_real_botnet() -> dict | None:
             classifier=sur, constraints=cons, ml_scaler=scaler,
             norm=2, n_gen=n_gen, n_pop=200, n_offsprings=100, seed=42,
             archive_size=24,  # the production default (config/moeva.yaml)
+            # Pallas association is opt-in; this exact shape (387 states x
+            # pop 203) is repeatedly validated (engine.use_pallas docstring)
+            use_pallas=True,
         )
         t0 = time.time()
         res = moeva.generate(x, minimize_class=1)
@@ -207,6 +210,9 @@ def main():
     moeva = Moeva2(
         classifier=sur, constraints=cons, ml_scaler=scaler,
         norm=2, n_gen=N_GEN, n_pop=N_POP, n_offsprings=N_OFF, seed=42,
+        # Pallas association is opt-in; this exact shape (1000 states x
+        # pop 103) is repeatedly validated (engine.use_pallas docstring)
+        use_pallas=True,
     )
 
     t0 = time.time()

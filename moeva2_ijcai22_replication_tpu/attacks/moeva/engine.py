@@ -91,13 +91,14 @@ class Moeva2:
     #: in the generation budget: converged late populations can no longer
     #: lose the constrained adversarials found mid-run.
     archive_size: int = 0
-    #: niche-association backend: None = auto (Pallas kernel on TPU, XLA
-    #: elsewhere); False forces the XLA path. The Pallas kernel is validated
-    #: at the rq1/bench shapes, but one large-program configuration
-    #: (S≈640 states x pop 200 on LCLD inside the defense pipeline) has been
-    #: observed to hard-crash the TPU runtime — callers hitting such a fault
-    #: can pin False (or set MOEVA_DISABLE_PALLAS=1) without losing
-    #: correctness, only the ~20% survival speedup.
+    #: niche-association backend. The Pallas kernel is ~20% faster on the
+    #: survival stage and bit-validated against the XLA path, but at several
+    #: LCLD state counts (278/537/640 observed; 1000 fine — no shape pattern)
+    #: it faults the TPU *worker process*: the whole experiment dies and the
+    #: backend is unusable until process restart, so a wrong auto-enable
+    #: costs far more than the speedup. Default (None) therefore resolves to
+    #: the XLA path; opt in per-call with True on shapes you have validated
+    #: (bench.py does), or globally with MOEVA_ENABLE_PALLAS=1.
     use_pallas: bool | None = None
     save_history: str | None = None
     #: generations per jitted scan segment when history is recorded; each
@@ -145,17 +146,16 @@ class Moeva2:
             )
         self._jit_init = None
         self._jit_segment = None
-        # Pallas-fused niche association on TPU (shard_map'd over the states
-        # axis under a mesh); XLA einsum path elsewhere (decided at trace
-        # time — the backend is fixed per process). MOEVA_DISABLE_PALLAS=1
-        # forces the XLA path (triage escape hatch).
+        # Pallas-fused niche association is opt-in (see the use_pallas
+        # docstring: the kernel can fault the TPU worker at some state
+        # counts); only meaningful on the TPU backend either way.
         import os
 
         if self.use_pallas is None:
-            disabled = os.environ.get("MOEVA_DISABLE_PALLAS", "") not in ("", "0")
-            self._use_pallas = jax.default_backend() == "tpu" and not disabled
+            enabled = os.environ.get("MOEVA_ENABLE_PALLAS", "") not in ("", "0")
         else:
-            self._use_pallas = bool(self.use_pallas)
+            enabled = bool(self.use_pallas)
+        self._use_pallas = enabled and jax.default_backend() == "tpu"
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):

@@ -210,9 +210,10 @@ def main():
     moeva = Moeva2(
         classifier=sur, constraints=cons, ml_scaler=scaler,
         norm=2, n_gen=N_GEN, n_pop=N_POP, n_offsprings=N_OFF, seed=42,
-        # Pallas association is opt-in; this exact shape (1000 states x
-        # pop 103) is repeatedly validated (engine.use_pallas docstring)
-        use_pallas=True,
+        # Pallas association is opt-in and only the default shape
+        # (1000 states x pop 103) is repeatedly validated — env-shrunk smoke
+        # runs fall back to the engine default (engine.use_pallas docstring)
+        use_pallas=True if (N_STATES == 1000 and N_POP == 100) else None,
     )
 
     t0 = time.time()

@@ -92,13 +92,16 @@ class Moeva2:
     #: lose the constrained adversarials found mid-run.
     archive_size: int = 0
     #: niche-association backend. The Pallas kernel is ~20% faster on the
-    #: survival stage and bit-validated against the XLA path, but at several
-    #: LCLD state counts (278/537/640 observed; 1000 fine — no shape pattern)
-    #: it faults the TPU *worker process*: the whole experiment dies and the
-    #: backend is unusable until process restart, so a wrong auto-enable
-    #: costs far more than the speedup. Default (None) therefore resolves to
-    #: the XLA path; opt in per-call with True on shapes you have validated
-    #: (bench.py does), or globally with MOEVA_ENABLE_PALLAS=1.
+    #: survival stage and bit-validated against the XLA path, but some
+    #: compiled configurations fault the TPU *worker process*: the whole
+    #: experiment dies and the backend is unusable until process restart.
+    #: The fault is program-dependent, not shape-alone (537 LCLD states
+    #: passes at n_gen=5 and faults at n_gen=50; 387 botnet and 1000 LCLD
+    #: pass at production budgets), so a wrong auto-enable costs far more
+    #: than the speedup. Default (None) therefore resolves to the XLA path;
+    #: opt in per-call with True on configurations validated by
+    #: ``tools/validate_pallas.py`` (bench.py does), or globally with
+    #: MOEVA_ENABLE_PALLAS=1.
     use_pallas: bool | None = None
     save_history: str | None = None
     #: generations per jitted scan segment when history is recorded; each

@@ -1,0 +1,152 @@
+"""Probe whether the Pallas association kernel is safe for YOUR attack program.
+
+The MoEvA Pallas niche-association kernel is ~15% faster end-to-end but some
+compiled configurations fault the TPU *worker process* (engine ``use_pallas``
+docstring). The fault is a property of the COMPILED PROGRAM, not the shape
+alone — state count AND scan length both matter (537 LCLD states passes at
+n_gen=5, faults at n_gen=50) — so the engine defaults to the XLA path and
+Pallas is opt-in per validated configuration. This tool does the validation:
+it compiles and runs the attack program you describe **in a subprocess**, so
+a kernel fault kills the probe child, never your session's backend.
+
+Probe the program you will actually run: same domain, states, pop,
+offsprings, n_gen, archive size, and history segmenting.
+
+    python tools/validate_pallas.py --states 537 --n-pop 200 --n-gen 50
+    -> UNSAFE: Pallas faulted ... keep use_pallas off
+    python tools/validate_pallas.py --states 1000 --n-pop 100 --n-gen 1000
+    -> SAFE: validated; opt in with use_pallas=True for this program
+    python tools/validate_pallas.py --domain botnet-real --n-pop 200 \
+        --archive-size 24 --n-gen 100     # bench.py's botnet program
+
+Exit code: 0 = safe, 1 = Pallas fault, 2 = probe could not run (setup
+failed before the kernel was involved — wrong paths, no TPU, ...).
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = "VALIDATE_PALLAS_CHILD"
+_SENTINEL_SETUP = "probe-setup-done"
+_SENTINEL_OK = "probe-ok"
+
+
+def _probe(args) -> None:
+    """Child body: build the requested program and run it with Pallas on."""
+    import numpy as np
+
+    from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+    from moeva2_ijcai22_replication_tpu.models.io import (
+        Surrogate, load_classifier,
+    )
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+    from moeva2_ijcai22_replication_tpu.models.scalers import (
+        fit_minmax, load_joblib_scaler,
+    )
+
+    ref = "/root/reference"
+    if args.domain == "botnet-real":
+        from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+
+        cons = BotnetConstraints(
+            f"{ref}/data/botnet/features.csv", f"{ref}/data/botnet/constraints.csv"
+        )
+        x = np.load(f"{ref}/data/botnet/x_candidates_common.npy")
+        if args.states:
+            x = x[: args.states]
+        sur = load_classifier(f"{ref}/models/botnet/nn.model")
+        scaler = load_joblib_scaler(f"{ref}/models/botnet/scaler.joblib")
+    else:
+        from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+        from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+
+        cons = LcldConstraints(
+            f"{ref}/data/lcld/features.csv", f"{ref}/data/lcld/constraints.csv"
+        )
+        x = synth_lcld(args.states or 1000, cons.schema, seed=0)
+        model = lcld_mlp()
+        sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=0))
+        scaler = fit_minmax(x.min(0), x.max(0))
+
+    moeva = Moeva2(
+        classifier=sur,
+        constraints=cons,
+        ml_scaler=scaler,
+        norm=2,
+        n_gen=args.n_gen,
+        n_pop=args.n_pop,
+        n_offsprings=args.n_offsprings,
+        archive_size=args.archive_size,
+        save_history=args.save_history or None,
+        history_chunk=args.history_chunk,
+        seed=0,
+        use_pallas=True,
+    )
+    # everything below this line involves the Pallas-enabled program; a
+    # death before the sentinel is a setup problem, not a kernel fault
+    print(_SENTINEL_SETUP, flush=True)
+    res = moeva.generate(x, 1)
+    assert np.isfinite(res.f).all()
+    print(_SENTINEL_OK)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--domain", choices=["lcld-synth", "botnet-real"],
+                    default="lcld-synth")
+    ap.add_argument("--states", type=int, default=0,
+                    help="0 = domain default (1000 synth / all 387 botnet)")
+    ap.add_argument("--n-pop", type=int, default=100)
+    ap.add_argument("--n-offsprings", type=int, default=100)
+    ap.add_argument("--n-gen", type=int, default=50)
+    ap.add_argument("--archive-size", type=int, default=0)
+    ap.add_argument("--save-history", choices=["reduced", "full"], default=None)
+    ap.add_argument("--history-chunk", type=int, default=50,
+                    help="segment length when history is recorded — it sets "
+                         "the compiled scan length, which the fault depends on")
+    args = ap.parse_args()
+
+    if os.environ.get(_CHILD):
+        _probe(args)
+        return 0
+
+    env = dict(os.environ, **{_CHILD: "1"})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, capture_output=True, text=True,
+            # compile (~40s) + generous run budget; a wedged (not crashed)
+            # worker must not hang the validator forever
+            timeout=300 + 0.2 * args.n_gen,
+        )
+        out, err, rc = proc.stdout, proc.stderr, proc.returncode
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        rc, timed_out = -1, True
+
+    prog = (f"({args.domain}, {args.states or 'default'} states, "
+            f"pop {args.n_pop}, n_gen {args.n_gen}, "
+            f"archive {args.archive_size}, history {args.save_history})")
+    if rc == 0 and _SENTINEL_OK in out:
+        print(f"SAFE: validated; opt in with use_pallas=True for {prog}")
+        return 0
+    if _SENTINEL_SETUP in out:
+        verdict = "hung" if timed_out else "faulted"
+        print(f"UNSAFE: Pallas-enabled program {verdict} at {prog} — keep use_pallas off")
+        for line in (err or out).strip().splitlines()[-1:]:
+            print(f"  last output: {line[:120]}")
+        return 1
+    print(f"probe could not run (setup failed before the kernel was involved) at {prog}")
+    for line in (err or out).strip().splitlines()[-1:]:
+        print(f"  last output: {line[:120]}")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,20 +45,23 @@ class NormState(NamedTuple):
         )
 
 
-def _update_extreme_points(f, nd_mask, ideal, extreme):
+def _update_extreme_points(f, nd_mask, ideal, extreme, asp_points):
     """ASF-minimising extreme points, previous extremes kept as candidates.
 
-    pymoo ``get_extreme_points_c``: weights are eye with 1e6 off-axis; values
-    below 1e-3 above the ideal point are snapped to 0.
+    pymoo ``get_extreme_points_c`` as called by ``AspirationPointSurvival``:
+    candidates are [previous extremes, non-dominated front, aspiration
+    points] in that order (ties resolve to the earlier row, argmin
+    semantics); weights are eye with 1e6 off-axis; values below 1e-3 above
+    the ideal point are snapped to 0.
     """
     n_obj = f.shape[-1]
     w = jnp.where(jnp.eye(n_obj, dtype=bool), 1.0, 1e6)
     cand = jnp.concatenate(
-        [extreme, jnp.where(nd_mask[:, None], f, _BIG)], axis=0
-    )  # (n_obj + M, n_obj)
+        [extreme, jnp.where(nd_mask[:, None], f, _BIG), asp_points], axis=0
+    )  # (n_obj + M + A, n_obj)
     shifted = cand - ideal
     shifted = jnp.where(shifted < 1e-3, 0.0, shifted)
-    asf = (shifted[None, :, :] * w[:, None, :]).max(-1)  # (n_obj, n_obj+M)
+    asf = (shifted[None, :, :] * w[:, None, :]).max(-1)  # (n_obj, n_obj+M+A)
     idx = jnp.argmin(asf, axis=1)
     return cand[idx]
 
@@ -96,18 +99,25 @@ def _solve3(m, b):
 
 
 def _nadir_point(extreme, ideal, worst, worst_of_front, worst_of_pop):
-    """Hyperplane intercepts with pymoo's fallback chain."""
+    """Hyperplane intercepts with pymoo's fallback chain.
+
+    On a successful solve the nadir is *clamped elementwise* to the running
+    worst point (pymoo's "NOTE: different to the proposed version in the
+    paper" branch); only a failed solve (singular / inconsistent / tiny
+    intercepts) falls back to worst-of-front, and a degenerate range falls
+    back per-axis to worst-of-population.
+    """
     n_obj = extreme.shape[0]
     m = extreme - ideal
     b = jnp.ones((n_obj,), m.dtype)
     plane = _solve3(m, b) if n_obj == 3 else jnp.linalg.solve(m, b)
     intercepts = 1.0 / plane
-    nadir = ideal + intercepts
+    nadir = jnp.minimum(ideal + intercepts, worst)
     ok = (
         jnp.all(jnp.isfinite(plane))
-        & jnp.allclose(m @ plane, b, atol=1e-6)
+        & jnp.allclose(m @ plane, b, rtol=1e-5, atol=1e-8)
         & jnp.all(intercepts > 1e-6)
-        & jnp.all(nadir <= worst + 1e-12)
+        & jnp.all(jnp.isfinite(nadir))
     )
     nadir = jnp.where(ok, nadir, worst_of_front)
     degenerate = (nadir - ideal) <= 1e-6
@@ -296,9 +306,15 @@ def _niching_fill(key, ranks, split_rank, niche, dist, niche_count, n_remaining,
 
 
 def _survive_pre(f, asp_points, state, n_survive):
-    """Per-state phase 1: ranks, normalisation update, survival directions."""
-    ideal = jnp.minimum(state.ideal, f.min(0))
-    worst = jnp.maximum(state.worst, f.max(0))
+    """Per-state phase 1: ranks, normalisation update, survival directions.
+
+    pymoo's ``AspirationPointSurvival`` folds the aspiration points into the
+    running ideal/worst updates and the extreme-point candidates (unlike
+    plain NSGA-III survival) — diffed against the vendored oracle in
+    ``tests/test_survival_pymoo_diff.py``.
+    """
+    ideal = jnp.minimum(state.ideal, jnp.minimum(f.min(0), asp_points.min(0)))
+    worst = jnp.maximum(state.worst, jnp.maximum(f.max(0), asp_points.max(0)))
 
     # Peel only until n_survive candidates are ranked: fronts beyond the
     # splitting front never survive, and the UNRANKED sentinel on the tail is
@@ -306,7 +322,7 @@ def _survive_pre(f, asp_points, state, n_survive):
     ranks = nd_ranks(f, n_stop=n_survive)
     nd_mask = ranks == 0
 
-    extreme = _update_extreme_points(f, nd_mask, ideal, state.extreme)
+    extreme = _update_extreme_points(f, nd_mask, ideal, state.extreme, asp_points)
     worst_of_pop = f.max(0)
     worst_of_front = jnp.where(nd_mask[:, None], f, -jnp.inf).max(0)
     nadir = _nadir_point(extreme, ideal, worst, worst_of_front, worst_of_pop)

@@ -48,7 +48,9 @@ def make_botnet_sat_builder(constraints: BotnetConstraints):
         x_init: np.ndarray, hot: np.ndarray, box: tuple | None = None
     ) -> LinearRows:
         # box unused: every botnet constraint is already linear, nothing to
-        # grid-search (the builder protocol passes it to all domains)
-        return LinearRows(rows=static_rows, fixes={})
+        # grid-search (the builder protocol passes it to all domains).
+        # rows is a fresh list per call: the engine may append state-specific
+        # rows (e.g. the softmax simplex row) to the returned spec.
+        return LinearRows(rows=list(static_rows), fixes={})
 
     return build

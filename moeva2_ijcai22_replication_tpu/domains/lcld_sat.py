@@ -13,9 +13,10 @@ HiGHS formulation:
   (int_rate is immutable, so both c_36 and c_60 are constants — the
   (1+r)^term power never has to live inside the MILP).
 - **the mutable ratio denominators annual_inc and total_acc are searched,
-  not pinned**: each gets a grid of candidate values over its ε-box (always
-  including the hot-start and initial values, so results are never worse
-  than a pin) selected by one-hot binaries — the denominator variable is the
+  not pinned**: each gets a grid of candidate values over its ε-box (the
+  hot-start and initial values are included after clamping into the box, so
+  in-box pins are never lost) selected by one-hot binaries — the denominator
+  variable is the
   exact linear combination Σ vₖ·zₖ, and each mode's ratio equality
   (g5: ratio = loan/annual_inc, g6: ratio = open/total) activates through
   big-M rows with benign magnitudes. This is the same mode-search
@@ -59,10 +60,16 @@ def _denominator_grid(
     hot_v: float, init_v: float, lo: float, hi: float, n: int = 5
 ) -> list:
     """Candidate pins for a searched ratio denominator: hot-start and initial
-    values (never worse than the old single pin) plus an n-point spread over
-    the ε-box; zeros and out-of-box values dropped, near-duplicates merged."""
-    cand = [float(hot_v), float(init_v)] + list(np.linspace(lo, hi, n))
-    cand = [v for v in cand if lo - 1e-12 <= v <= hi + 1e-12 and v != 0.0]
+    values clamped into the ε-box (the directional L2 radii can leave the raw
+    hot displacement slightly outside it) plus an n-point spread over the box;
+    near-zero values dropped — a tiny |v| would put num_hi/|v| big-Ms in the
+    rows and wreck the MILP conditioning — and near-duplicates merged."""
+    cand = [
+        float(np.clip(hot_v, lo, hi)),
+        float(np.clip(init_v, lo, hi)),
+    ] + list(np.linspace(lo, hi, n))
+    tol = 1e-6 * max(1.0, hi - lo)
+    cand = [v for v in cand if abs(v) > tol]
     out: list = []
     for v in sorted(cand):
         if not out or abs(v - out[-1]) > 1e-9 * max(1.0, abs(v)):

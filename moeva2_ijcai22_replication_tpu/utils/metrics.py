@@ -86,7 +86,10 @@ def main(argv=None):
     cols = ["project_name", "attack_name", "budget", "n_state", "eps", "time"]
     header = cols + [f"o{i}" for i in range(1, 8)]
     table = [header] + [
-        [f"{v:.4f}" if isinstance(v, float) else str(v) for v in (r.get(c) for c in header)]
+        [
+            f"{v:.4f}" if isinstance(v, float) else ("-" if v is None else str(v))
+            for v in (r.get(c) for c in header)
+        ]
         for r in rows
     ]
     widths = [max(len(row[i]) for row in table) for i in range(len(header))]

@@ -28,6 +28,86 @@ import pytest  # noqa: E402
 REFERENCE_DATA = "/root/reference/data"
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow",
+        action="store_true",
+        default=False,
+        help="also run tests marked slow (full end-to-end/parity tier)",
+    )
+
+
+# Tests measured >10 s on the virtual 8-device CPU mesh (pytest --durations):
+# centrally tiered here so the default invocation stays <5 min while every
+# subsystem keeps at least one quick representative. Module-local
+# ``@pytest.mark.slow`` decorators compose with this list.
+SLOW_TESTS = {
+    "test_checkpoint.py": {
+        "test_resume_is_bit_identical",
+        "test_resume_restores_mesh_sharded_carry",
+        "test_stale_checkpoint_from_different_run_is_ignored",
+        "test_corrupt_checkpoint_falls_back_to_fresh_start",
+    },
+    "test_moeva_engine.py": {
+        "test_archive_appends_columns_and_is_monotone",
+        "test_archive_members_track_population_history",
+        "test_chunked_history_matches_single_scan",
+        "test_mesh_sharded_states",
+        "test_deterministic",
+    },
+    "test_train.py": {
+        "test_class_weights_shift_the_decision",
+        "test_roundtrip_and_dispatch",
+    },
+    "test_runners.py": {
+        "test_poisoned_point_continues_in_process",
+        "test_moeva_runner_pads_indivisible_candidates",
+        "test_pgd_runner_pads_indivisible_candidates",
+        "test_rq1_shaped_grid",
+        "test_moeva_runner_streams_events",
+        "test_end_to_end_and_skip",
+        "test_history_artifact",
+    },
+    "test_softmax_genes.py": {
+        "test_attack_keeps_softmax_population_on_simplex",
+    },
+    "test_defense.py": {
+        "test_artifact_family",
+        "test_botnet_knobs_artifact_family",
+        "test_iteration",
+    },
+    "test_parity_botnet.py": {
+        "test_cpu_small_run_matches_pinned_rates",
+    },
+    "test_pgd.py": {
+        "test_loss_strategies_all_run",
+        "test_restart_history_follows_kept_restart",
+        "test_autopgd_random_restarts_run",
+    },
+    "test_moeva_units.py": {
+        "test_survive_batch_matches_vmapped_survive",
+        "test_select_count_and_elitism",
+    },
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    """Quick tier by default: the slow end-to-end/parity tests only run under
+    ``--runslow`` so the default invocation fits typical CI wall-clock caps
+    (the full suite takes ~15 min on the virtual 8-device mesh)."""
+    for item in items:
+        module = os.path.basename(str(item.fspath))
+        name = getattr(item, "originalname", None) or item.name
+        if name in SLOW_TESTS.get(module, ()):
+            item.add_marker(pytest.mark.slow)
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="slow tier: pass --runslow to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
+
+
 @pytest.fixture(scope="session")
 def ref_data_dir():
     if not os.path.isdir(REFERENCE_DATA):

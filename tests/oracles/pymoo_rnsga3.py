@@ -1,0 +1,385 @@
+"""Clean-room numpy transcription of pymoo 0.4.2.2's R-NSGA-III survival.
+
+The reference instantiates ``RNSGA3(ref_points=energy(3, n_pop, seed=1),
+pop_per_ref_point=1, ...)`` (``/root/reference/src/attacks/moeva2/moeva2.py:
+113-124``), whose selection semantics live in pymoo's
+``AspirationPointSurvival._do`` plus the NSGA-III helpers it calls
+(``get_extreme_points_c``, ``get_nadir_point``, ``associate_to_niches``,
+``niching``, ``calc_niche_count``) and ``get_ref_dirs_from_points``.
+
+pymoo is not installable in this image (SURVEY §7 risk #1 prescribes a
+recorded-trace diff; VERDICT r3 item 1 prescribes this vendored oracle as the
+fallback), so this module is a direct, loop-for-loop transcription of the
+pymoo 0.4.2.2 routines from their published algorithm, kept deliberately
+naive — python loops, mutable state, ``np.random.RandomState`` — so that it
+is easy to audit against the upstream source and shares no code with the
+jitted kernel it validates (``attacks/moeva/survival.py``).
+
+Transcription notes (places where upstream 0.4.2.2 is ambiguous or quirky):
+
+- ``AspirationPointSurvival`` folds the user aspiration points into the
+  running ideal/worst updates AND into the extreme-point candidate set
+  (unlike plain NSGA-III's ``ReferenceDirectionSurvival``).
+- ``get_nadir_point``: on a successful hyperplane solve the nadir is
+  *clamped elementwise* to the running worst point ("NOTE: different to the
+  proposed version in the paper" upstream); only a failed/degenerate solve
+  falls back to worst-of-front, and a too-small range falls back per-axis to
+  worst-of-population.
+- ``niching`` draws from the global numpy RNG upstream; here every draw goes
+  through an explicit ``RandomState`` so the diff test can seed it.
+- upstream passes ``worst_of_front``/``worst_of_population`` positionally
+  into ``get_nadir_point``; this transcription uses the keyword reading
+  (fallback = worst of front, degenerate fill = worst of population), which
+  matches the parameter names and the NSGA-III paper.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+# -- non-dominated sorting ---------------------------------------------------
+
+
+def dominates(a: np.ndarray, b: np.ndarray) -> bool:
+    """Standard Deb domination for minimisation, no epsilon, no constraints
+    (pymoo ``Dominator`` with CV-free populations)."""
+    return bool(np.all(a <= b) and np.any(a < b))
+
+
+def fast_non_dominated_sort(F: np.ndarray, n_stop_if_ranked: int | None = None):
+    """Front lists by iterative peeling; stops once ``n_stop_if_ranked``
+    candidates are ranked (the last front may overshoot). Returns
+    ``(fronts, rank)`` with unranked candidates at rank ``len(F)`` (an
+    out-of-band sentinel; upstream uses 1e16)."""
+    n = len(F)
+    if n_stop_if_ranked is None:
+        n_stop_if_ranked = n
+    dom = np.zeros((n, n), dtype=bool)
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                dom[i, j] = dominates(F[i], F[j])
+
+    remaining = np.ones(n, dtype=bool)
+    fronts: list[np.ndarray] = []
+    rank = np.full(n, n, dtype=int)
+    n_ranked = 0
+    r = 0
+    while remaining.any() and n_ranked < n_stop_if_ranked:
+        n_dominators = (dom & remaining[:, None]).sum(axis=0)
+        front = np.where(remaining & (n_dominators == 0))[0]
+        fronts.append(front)
+        rank[front] = r
+        remaining[front] = False
+        n_ranked += len(front)
+        r += 1
+    return fronts, rank
+
+
+# -- normalisation helpers (nsga3.py) ----------------------------------------
+
+
+def get_extreme_points_c(F: np.ndarray, ideal_point: np.ndarray, extreme_points=None):
+    n_obj = F.shape[1]
+    weights = np.eye(n_obj)
+    weights[weights == 0] = 1e6
+
+    _F = F
+    if extreme_points is not None:
+        _F = np.concatenate([extreme_points, _F], axis=0)
+
+    __F = _F - ideal_point
+    __F[__F < 1e-3] = 0
+
+    F_asf = np.max(__F * weights[:, None, :], axis=2)
+    I = np.argmin(F_asf, axis=1)
+    return _F[I, :]
+
+
+def get_nadir_point(extreme_points, ideal_point, worst_point, worst_of_front, worst_of_population):
+    """Transcription note: upstream relies on ``np.linalg.LinAlgError`` to
+    detect a singular extreme-point matrix. When the matrix has *duplicate
+    rows* (the same candidate minimises the ASF on two axes — routine in
+    degenerate fronts), the system is exactly singular but consistent, and
+    whether LAPACK raises is build-dependent: pivoting rounding residues of
+    order 1e-19 can let ``dgesv`` return an arbitrary member of the solution
+    family instead of raising (observed on this image's numpy: a duplicate
+    extreme matrix from a rank-1 objective cloud solved "successfully" while
+    the textbook duplicate-row matrix raised). Upstream behaviour in this
+    case is therefore BLAS noise, not semantics. The oracle pins the
+    deterministic reading — an explicit condition-number test — and the
+    jitted kernel's Cramer solve + consistency check agrees with it (its
+    adjugate cancels exactly on duplicate rows, failing the residual
+    check)."""
+    try:
+        M = extreme_points - ideal_point
+        b = np.ones(extreme_points.shape[1])
+        plane = np.linalg.solve(M, b)
+        if np.linalg.cond(M) > 1e12:
+            raise np.linalg.LinAlgError()
+        intercepts = 1 / plane
+        nadir_point = ideal_point + intercepts
+        if (
+            not np.allclose(np.dot(M, plane), b)
+            or np.any(intercepts <= 1e-6)
+            or np.any(np.isnan(nadir_point))
+        ):
+            raise np.linalg.LinAlgError()
+        # clamp to the running worst point rather than failing (upstream
+        # "NOTE: different to the proposed version in the paper")
+        b_mask = nadir_point > worst_point
+        nadir_point[b_mask] = worst_point[b_mask]
+    except np.linalg.LinAlgError:
+        nadir_point = np.array(worst_of_front, dtype=float, copy=True)
+
+    b_mask = nadir_point - ideal_point <= 1e-6
+    nadir_point[b_mask] = worst_of_population[b_mask]
+    return nadir_point
+
+
+# -- aspiration reference directions (rnsga3.py) -----------------------------
+
+
+def line_plane_intersection(l0, l1, p0, p_no, epsilon=1e-6):
+    l = l1 - l0
+    dot = np.dot(l, p_no)
+    if abs(dot) > epsilon:
+        w = p0 - l0
+        d = np.dot(w, p_no) / dot
+        return l0 + l * d
+    # line parallel to plane: upstream projects l1 onto the plane
+    ref_proj = l1 - np.dot(l1 - p0, p_no) * p_no
+    return ref_proj
+
+
+def get_ref_dirs_from_points(ref_point: np.ndarray, ref_dirs: np.ndarray, mu: float = 0.1):
+    """Per aspiration point: mu-shrunk copy of the Das-Dennis cluster
+    re-centred on the central projection of the point onto the unit-simplex
+    plane, octant-clipped; plus the extreme axes."""
+    n_obj = ref_point.shape[1]
+
+    val = []
+    n_vector = np.ones(n_obj) / np.linalg.norm(np.ones(n_obj))
+    point_on_plane = np.eye(n_obj)[0]
+
+    for point in ref_point:
+        ref_dir_for_aspiration_point = mu * np.copy(ref_dirs)
+        cent = np.mean(ref_dir_for_aspiration_point, axis=0)
+        intercept = line_plane_intersection(
+            np.zeros(n_obj), point, point_on_plane, n_vector
+        )
+        shift = intercept - cent
+        ref_dir_for_aspiration_point += shift
+
+        if not (ref_dir_for_aspiration_point > 0).min():
+            ref_dir_for_aspiration_point[ref_dir_for_aspiration_point < 0] = 0
+            ref_dir_for_aspiration_point = (
+                ref_dir_for_aspiration_point
+                / np.sum(ref_dir_for_aspiration_point, axis=1)[:, None]
+            )
+        val.extend(ref_dir_for_aspiration_point)
+
+    val.extend(np.eye(n_obj))
+    return np.array(val)
+
+
+# -- association + niching (nsga3.py) ----------------------------------------
+
+
+def calc_perpendicular_distance(N, ref_dirs):
+    u = np.tile(ref_dirs, (len(N), 1))
+    v = np.repeat(N, len(ref_dirs), axis=0)
+    norm_u = np.linalg.norm(u, axis=1)
+    scalar_proj = np.sum(v * u, axis=1) / norm_u
+    proj = scalar_proj[:, None] * u / norm_u[:, None]
+    val = np.linalg.norm(proj - v, axis=1)
+    return np.reshape(val, (len(N), len(ref_dirs)))
+
+
+def associate_to_niches(F, niches, ideal_point, nadir_point, utopian_epsilon=0.0):
+    utopian_point = ideal_point - utopian_epsilon
+    denom = nadir_point - utopian_point
+    denom[denom == 0] = 1e-12
+
+    N = (F - utopian_point) / denom
+    dist_matrix = calc_perpendicular_distance(N, niches)
+    niche_of_individuals = np.argmin(dist_matrix, axis=1)
+    dist_to_niche = dist_matrix[np.arange(F.shape[0]), niche_of_individuals]
+    return niche_of_individuals, dist_to_niche
+
+
+def calc_niche_count(n_niches, niche_of_individuals):
+    niche_count = np.zeros(n_niches, dtype=int)
+    index, count = np.unique(niche_of_individuals, return_counts=True)
+    niche_count[index] = count
+    return niche_count
+
+
+def niching(F, n_remaining, niche_count, niche_of_individuals, dist_to_niche, rng):
+    """Upstream pick loop, verbatim dynamics; ``rng`` replaces the global
+    numpy RNG. ``F``/``niche_of_individuals``/``dist_to_niche`` are the
+    last-front subarrays; returns ``(indices_into_them, deterministic)``.
+
+    ``deterministic`` is instrumentation (not upstream): True iff no RNG
+    draw could have changed the returned index set — every sweep used its
+    whole min-count cohort (no permutation truncation), every non-empty-niche
+    pick had a single candidate, and every empty-niche argmin was tie-free.
+    """
+    survivors = []
+    mask = np.full(len(F), True)
+    deterministic = True
+
+    while len(survivors) < n_remaining:
+        n_select = n_remaining - len(survivors)
+
+        next_niches_list = np.unique(niche_of_individuals[mask])
+        next_niche_count = niche_count[next_niches_list]
+        min_niche_count = next_niche_count.min()
+        next_niches = next_niches_list[
+            np.where(next_niche_count == min_niche_count)[0]
+        ]
+        if len(next_niches) > n_select:
+            deterministic = False  # random cutoff cohort
+        next_niches = next_niches[rng.permutation(len(next_niches))[:n_select]]
+
+        for next_niche in next_niches:
+            next_ind = np.where(
+                np.logical_and(niche_of_individuals == next_niche, mask)
+            )[0]
+            rng.shuffle(next_ind)
+
+            if niche_count[next_niche] == 0:
+                d = dist_to_niche[next_ind]
+                if (d == d.min()).sum() > 1:
+                    deterministic = False  # argmin tie broken by shuffle
+                next_ind = next_ind[np.argmin(d)]
+            else:
+                if len(next_ind) > 1:
+                    deterministic = False  # uniform random member pick
+                next_ind = next_ind[0]
+
+            mask[next_ind] = False
+            survivors.append(int(next_ind))
+            niche_count[next_niche] += 1
+
+    return survivors, deterministic
+
+
+# -- the survival itself (rnsga3.py AspirationPointSurvival._do) -------------
+
+
+class OracleNormState:
+    """ideal/worst/extreme memory carried across generations (the fields
+    ``AspirationPointSurvival`` keeps on self)."""
+
+    def __init__(self, n_obj: int):
+        self.ideal_point = np.full(n_obj, np.inf)
+        self.worst_point = np.full(n_obj, -np.inf)
+        self.extreme_points = None
+
+
+def aspiration_survive(
+    F: np.ndarray,  # (M, n_obj) merged population objectives
+    ref_points: np.ndarray,  # (A, n_obj) user aspiration points
+    aspiration_ref_dirs: np.ndarray,  # (K, n_obj) Das-Dennis cluster
+    n_survive: int,
+    state: OracleNormState,
+    rng: np.random.RandomState,
+    mu: float = 0.1,
+):
+    """One ``AspirationPointSurvival._do`` round. Mutates ``state``. Returns
+    ``(survivor_indices_into_F, debug)``."""
+    F = np.asarray(F, dtype=float)
+
+    state.ideal_point = np.min(
+        np.vstack((state.ideal_point, F, ref_points)), axis=0
+    )
+    state.worst_point = np.max(
+        np.vstack((state.worst_point, F, ref_points)), axis=0
+    )
+
+    fronts, rank = fast_non_dominated_sort(F, n_stop_if_ranked=n_survive)
+    non_dominated = fronts[0]
+
+    state.extreme_points = get_extreme_points_c(
+        np.vstack([F[non_dominated], ref_points]),
+        state.ideal_point,
+        extreme_points=state.extreme_points,
+    )
+
+    worst_of_population = np.max(F, axis=0)
+    worst_of_front = np.max(F[non_dominated, :], axis=0)
+
+    nadir_point = get_nadir_point(
+        state.extreme_points,
+        state.ideal_point,
+        state.worst_point,
+        worst_of_front,
+        worst_of_population,
+    )
+
+    # restrict to ranked individuals, in front order (upstream re-indexes the
+    # population; here we carry original indices alongside)
+    I = np.concatenate(fronts).astype(int)
+    rank_I = rank[I]
+    F_I = F[I]
+
+    # front index lists relative to the truncated population
+    counter = 0
+    local_fronts = []
+    for f in fronts:
+        local_fronts.append(np.arange(counter, counter + len(f)))
+        counter += len(f)
+    last_front = local_fronts[-1]
+
+    denom = nadir_point - state.ideal_point
+    denom = np.where(denom == 0, 1e-12, denom)
+    unit_ref_points = (ref_points - state.ideal_point) / denom
+    ref_dirs = get_ref_dirs_from_points(unit_ref_points, aspiration_ref_dirs, mu=mu)
+
+    niche_of_individuals, dist_to_niche = associate_to_niches(
+        F_I, ref_dirs, state.ideal_point, nadir_point
+    )
+
+    if len(F_I) > n_survive:
+        if len(local_fronts) == 1:
+            n_remaining = n_survive
+            until_last_front = np.array([], dtype=int)
+            niche_count = np.zeros(len(ref_dirs), dtype=int)
+        else:
+            until_last_front = np.concatenate(local_fronts[:-1])
+            niche_count = calc_niche_count(
+                len(ref_dirs), niche_of_individuals[until_last_front]
+            )
+            n_remaining = n_survive - len(until_last_front)
+
+        S, niching_deterministic = niching(
+            F_I[last_front, :],
+            n_remaining,
+            niche_count,
+            niche_of_individuals[last_front],
+            dist_to_niche[last_front],
+            rng,
+        )
+        survivors_local = np.concatenate(
+            (until_last_front, last_front[np.array(S, dtype=int)])
+        ).astype(int)
+    else:
+        survivors_local = np.arange(len(F_I))
+        niching_deterministic = True
+
+    debug = {
+        "ideal": state.ideal_point.copy(),
+        "worst": state.worst_point.copy(),
+        "extreme": np.array(state.extreme_points, copy=True),
+        "nadir": np.array(nadir_point, copy=True),
+        "ref_dirs": ref_dirs,
+        "rank": rank,
+        "fronts": fronts,
+        "niche": niche_of_individuals,
+        "dist": dist_to_niche,
+        "ranked_idx": I,
+        "niching_deterministic": niching_deterministic,
+    }
+    return I[survivors_local], debug

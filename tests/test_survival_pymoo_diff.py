@@ -1,0 +1,347 @@
+"""Differential validation of the jitted R-NSGA-III survival against a
+vendored pymoo-0.4.2.2 oracle (``tests/oracles/pymoo_rnsga3.py``).
+
+SURVEY §7 risk #1 / VERDICT r3 item 1: ``attacks/moeva/survival.py`` is the
+most semantics-dense module in the tree and had no external check. pymoo is
+not installable here, so the oracle is a clean-room numpy transcription of
+``AspirationPointSurvival._do`` and its helpers; this test fuzzes both
+implementations over >1000 cases and compares
+
+- the normalisation geometry exactly (ideal/worst/extreme points, nadir,
+  survival reference directions, per-candidate niche + distance),
+- the survivor multiset exactly wherever the oracle is deterministic
+  (same answer across oracle RNG seeds),
+- the per-candidate survival *frequency* distributionally where the pymoo
+  pick loop is genuinely random (cutoff cohorts, random member picks).
+
+Cases cover degenerate fronts (totally-ordered rank-1 objectives), duplicate
+rows, discrete objectives with mass ties, constant columns (degenerate
+ranges), disjoint F/aspiration ranges, warm vs fresh normalisation state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import survival as sv
+from oracles import pymoo_rnsga3 as oracle
+
+N_OBJ = 3
+K1 = np.full((1, N_OBJ), 1.0 / N_OBJ)  # Das-Dennis cluster, pop_per_ref_point=1
+
+
+# -- jitted wrappers (compiled once per shape) -------------------------------
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _jax_geometry(f, asp, state, n_survive):
+    ranks, dirs, nadir, new_state = sv._survive_pre(f, asp, state, n_survive)
+    niche, dist = sv._associate(f, dirs, new_state.ideal, nadir)
+    return ranks, dirs, nadir, new_state, niche, dist
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _jax_survive(key, f, asp, state, n_survive):
+    return sv.survive(key, f, asp, state, n_survive)
+
+
+# -- case generation ---------------------------------------------------------
+
+
+def _asp_points(rng, a):
+    """Aspiration points on the unit simplex (what the engine feeds: energy
+    reference directions)."""
+    p = rng.dirichlet(np.ones(N_OBJ), size=a)
+    return p
+
+
+def _gen_f(rng, m, kind):
+    f = rng.uniform(size=(m, N_OBJ))
+    if kind == "uniform":
+        return f
+    if kind == "scaled":
+        return f * rng.uniform(0.5, 20.0, size=N_OBJ) + rng.uniform(
+            -5.0, 5.0, size=N_OBJ
+        )
+    if kind == "dup":
+        k = max(1, m // 3)
+        f[m - k :] = f[:k]
+        return f
+    if kind == "rank1":  # totally ordered: every front is a single point
+        return rng.uniform(0.1, 1.0, size=(m, 1)) * rng.uniform(
+            0.2, 2.0, size=(1, N_OBJ)
+        )
+    if kind == "discrete":  # mass ties and duplicated fronts
+        return rng.integers(0, 3, size=(m, N_OBJ)).astype(float)
+    if kind == "const_col":
+        f[:, rng.integers(0, N_OBJ)] = 0.7
+        return f
+    if kind == "tiny_range":
+        return 0.5 + 1e-9 * f
+    if kind == "neg":
+        return f - 2.0
+    raise ValueError(kind)
+
+
+KINDS = [
+    "uniform",
+    "scaled",
+    "dup",
+    "rank1",
+    "discrete",
+    "const_col",
+    "tiny_range",
+    "neg",
+]
+# (M merged, n_survive, A aspiration points); the first is engine-like
+# geometry (n_survive = A + n_obj, M = n_survive + n_offsprings)
+SHAPES = [(18, 11, 8), (12, 6, 5), (28, 14, 12)]
+
+
+def _case_stream(n_cases, seed0):
+    i = 0
+    c = 0
+    while c < n_cases:
+        kind = KINDS[i % len(KINDS)]
+        m, n_survive, a = SHAPES[(i // len(KINDS)) % len(SHAPES)]
+        yield i, kind, m, n_survive, a, seed0 + i
+        i += 1
+        c += 1
+
+
+def _rows_multiset(f, idx, tol_digits=10):
+    return sorted(tuple(np.round(f[j], tol_digits)) for j in idx)
+
+
+def _oracle_deterministic(f, asp, n_survive, state_proto, seed=1000):
+    """One oracle selection round; report ``(is_deterministic, multiset)``.
+    Determinism comes from the oracle's own instrumentation of the niching
+    loop (exact: True iff no RNG draw could change the index set), not from
+    sampling seeds — sampling misclassifies p≈0.5 coin-flip cases."""
+    st = oracle.OracleNormState(N_OBJ)
+    st.ideal_point = state_proto.ideal_point.copy()
+    st.worst_point = state_proto.worst_point.copy()
+    st.extreme_points = (
+        None
+        if state_proto.extreme_points is None
+        else state_proto.extreme_points.copy()
+    )
+    idx, dbg = oracle.aspiration_survive(
+        f, asp, K1, n_survive, st, np.random.RandomState(seed)
+    )
+    return dbg["niching_deterministic"], _rows_multiset(f, idx)
+
+
+def _to_jax_state(st_oracle_prev, dtype=jnp.float64):
+    """NormState mirroring an oracle state *before* a survival round."""
+    if st_oracle_prev is None:
+        return sv.NormState.init(N_OBJ, dtype)
+    ext = (
+        jnp.full((N_OBJ, N_OBJ), sv._BIG, dtype)
+        if st_oracle_prev.extreme_points is None
+        else jnp.asarray(st_oracle_prev.extreme_points, dtype)
+    )
+    return sv.NormState(
+        ideal=jnp.asarray(st_oracle_prev.ideal_point, dtype),
+        worst=jnp.asarray(st_oracle_prev.worst_point, dtype),
+        extreme=ext,
+    )
+
+
+def _run_diff_case(case_seed, kind, m, n_survive, a, n_generations=3):
+    """Run a multi-generation sequence through oracle and kernel, comparing
+    geometry each generation; returns per-generation records for the
+    selection comparison."""
+    rng = np.random.default_rng(case_seed)
+    asp = _asp_points(rng, a)
+    asp_j = jnp.asarray(asp)
+
+    st_o = oracle.OracleNormState(N_OBJ)
+    st_j = sv.NormState.init(N_OBJ, jnp.float64)
+    records = []
+
+    for gen in range(n_generations):
+        # generation 0 mirrors the engine's warm-up round: M == n_survive
+        m_gen = n_survive if gen == 0 else m
+        f = _gen_f(rng, m_gen, kind)
+
+        st_o_before = oracle.OracleNormState(N_OBJ)
+        st_o_before.ideal_point = st_o.ideal_point.copy()
+        st_o_before.worst_point = st_o.worst_point.copy()
+        st_o_before.extreme_points = (
+            None if st_o.extreme_points is None else st_o.extreme_points.copy()
+        )
+
+        idx_o, dbg = oracle.aspiration_survive(
+            f, asp, K1, n_survive, st_o, np.random.RandomState(case_seed + gen)
+        )
+
+        f_j = jnp.asarray(f)
+        ranks, dirs, nadir, st_j_new, niche, dist = _jax_geometry(
+            f_j, asp_j, st_j, n_survive
+        )
+
+        # --- geometry must match exactly (up to fp64 noise) ---
+        np.testing.assert_allclose(
+            np.asarray(st_j_new.ideal), dbg["ideal"], rtol=1e-9, atol=1e-12,
+            err_msg=f"ideal mismatch (kind={kind} gen={gen})",
+        )
+        np.testing.assert_allclose(
+            np.asarray(st_j_new.worst), dbg["worst"], rtol=1e-9, atol=1e-12,
+            err_msg=f"worst mismatch (kind={kind} gen={gen})",
+        )
+        np.testing.assert_allclose(
+            np.asarray(st_j_new.extreme), dbg["extreme"], rtol=1e-7, atol=1e-9,
+            err_msg=f"extreme points mismatch (kind={kind} gen={gen})",
+        )
+        # An ill-conditioned (but not deterministically-singular) extreme
+        # matrix sits in the band where the oracle's LAPACK solve and the
+        # kernel's Cramer solve legitimately disagree at the tolerance
+        # boundary (see the oracle's get_nadir_point note); skip exact
+        # comparison there. Deterministically-singular systems (cond>=1e15,
+        # e.g. duplicate extreme rows) take the same fallback on both sides
+        # and stay fully compared.
+        cond = np.linalg.cond(dbg["extreme"] - dbg["ideal"])
+        borderline = 1e9 < cond < 1e15
+        if not borderline:
+            np.testing.assert_allclose(
+                np.asarray(nadir), dbg["nadir"], rtol=1e-7, atol=1e-9,
+                err_msg=f"nadir mismatch (kind={kind} gen={gen}, cond={cond:.2e})",
+            )
+        if not borderline:
+            np.testing.assert_allclose(
+                np.asarray(dirs), dbg["ref_dirs"], rtol=1e-7, atol=1e-9,
+                err_msg=f"ref dirs mismatch (kind={kind} gen={gen})",
+            )
+
+        # ranks agree on every candidate the oracle ranked (the kernel's
+        # unranked tail keeps a sentinel; the oracle's keeps len(F))
+        ranks_np = np.asarray(ranks)
+        ranked = dbg["rank"] < len(f)
+        kernel_ranked = ranks_np != np.iinfo(np.int32).max
+        assert (ranked == kernel_ranked).all(), f"ranked-set mismatch ({kind})"
+        assert (ranks_np[ranked] == dbg["rank"][ranked]).all(), (
+            f"front ranks mismatch (kind={kind} gen={gen})"
+        )
+
+        if not borderline:
+            # niche association: oracle reports the ranked subset in front
+            # order; distances are tie-invariant so compare them always
+            ranked_idx = dbg["ranked_idx"]
+            np.testing.assert_allclose(
+                np.asarray(dist)[ranked_idx], dbg["dist"], rtol=1e-6, atol=1e-9,
+                err_msg=f"niche distance mismatch (kind={kind} gen={gen})",
+            )
+            records.append(
+                {
+                    "f": f,
+                    "st_o_before": st_o_before,
+                    "st_j_before": st_j,
+                    "idx_o": idx_o,
+                }
+            )
+        st_j = st_j_new
+
+    return asp, records
+
+
+# -- tests -------------------------------------------------------------------
+
+
+def _diff_fuzz(n_cases, seed0):
+    n_det = n_rand = 0
+    for i, kind, m, n_survive, a, seed in _case_stream(n_cases, seed0):
+        asp, records = _run_diff_case(seed, kind, m, n_survive, a)
+        asp_j = jnp.asarray(asp)
+        for gen, rec in enumerate(records):
+            f = rec["f"]
+            det, surv_o = _oracle_deterministic(
+                f, asp_j.__array__(), n_survive, rec["st_o_before"]
+            )
+            for key_i in range(2):
+                key = jax.random.PRNGKey(seed * 7 + gen * 3 + key_i)
+                mask, _, _ = _jax_survive(
+                    key, jnp.asarray(f), asp_j, rec["st_j_before"], n_survive
+                )
+                mask = np.asarray(mask)
+                assert mask.sum() == n_survive, (
+                    f"survivor count {mask.sum()} != {n_survive} "
+                    f"(kind={kind} case={i} gen={gen})"
+                )
+                if det:
+                    got = _rows_multiset(f, np.where(mask)[0])
+                    assert got == surv_o, (
+                        f"deterministic survivor set mismatch "
+                        f"(kind={kind} case={i} gen={gen})"
+                    )
+                    n_det += 1
+                else:
+                    n_rand += 1
+    # the stream must actually exercise the deterministic comparison
+    assert n_det > n_cases, f"too few deterministic checks: {n_det}"
+
+
+def test_survival_matches_pymoo_oracle_quick():
+    _diff_fuzz(n_cases=60, seed0=20_000)
+
+
+@pytest.mark.slow
+def test_survival_matches_pymoo_oracle_full():
+    _diff_fuzz(n_cases=400, seed0=50_000)
+
+
+@pytest.mark.slow
+def test_survival_random_cutoff_distribution():
+    """Where the pymoo niching is random (cutoff cohorts / member picks),
+    compare per-candidate survival frequencies over many seeds."""
+    n_draws = 260
+    checked = 0
+    for i, kind, m, n_survive, a, seed in _case_stream(40, 90_000):
+        if kind in ("dup", "discrete", "rank1"):
+            continue  # duplicate rows make index-marginals incomparable
+        asp, records = _run_diff_case(seed, kind, m, n_survive, a)
+        asp_j = jnp.asarray(asp)
+        rec = records[-1]
+        f = rec["f"]
+        det, _ = _oracle_deterministic(f, asp, n_survive, rec["st_o_before"])
+        if det:
+            continue
+        # oracle marginals
+        freq_o = np.zeros(len(f))
+        for s in range(n_draws):
+            st = oracle.OracleNormState(N_OBJ)
+            st.ideal_point = rec["st_o_before"].ideal_point.copy()
+            st.worst_point = rec["st_o_before"].worst_point.copy()
+            st.extreme_points = (
+                None
+                if rec["st_o_before"].extreme_points is None
+                else rec["st_o_before"].extreme_points.copy()
+            )
+            idx, _ = oracle.aspiration_survive(
+                f, asp, K1, n_survive, st, np.random.RandomState(3_000 + s)
+            )
+            freq_o[idx] += 1.0
+        freq_o /= n_draws
+        # kernel marginals
+        freq_j = np.zeros(len(f))
+        f_j = jnp.asarray(f)
+        for s in range(n_draws):
+            key = jax.random.PRNGKey(600_000 + s)
+            mask, _, _ = _jax_survive(key, f_j, asp_j, rec["st_j_before"], n_survive)
+            freq_j += np.asarray(mask)
+        freq_j /= n_draws
+        # binomial noise at n=260 is sigma <= 0.031 per side
+        assert np.abs(freq_o - freq_j).max() < 0.15, (
+            f"survival frequency diverges (kind={kind} case={i}): "
+            f"max|Δ|={np.abs(freq_o - freq_j).max():.3f}"
+        )
+        assert np.abs(freq_o - freq_j).mean() < 0.03
+        checked += 1
+        if checked >= 8:
+            break
+    assert checked >= 3, "fuzz stream produced too few random-cutoff cases"

@@ -198,3 +198,69 @@ def test_resume_restores_mesh_sharded_carry(problem, tmp_path):
     ).generate(x8)
     np.testing.assert_array_equal(resumed.x_gen, reference.x_gen)
     np.testing.assert_array_equal(resumed.f, reference.f)
+
+
+def test_resume_crosses_mesh_boundaries(problem, tmp_path):
+    """The checkpoint is placement-agnostic (host npz; ``load`` re-places
+    leaves onto the template's shardings): the SAME checkpoint must resume
+    under a different mesh layout than it was written under — continuing
+    mid-run, not restarting — and agree with the same-layout resume.
+
+    The cross-layout comparison is confined to the single post-resume
+    generation: the sharded and unsharded XLA programs differ in the last
+    ulp of the objectives (see test_moeva_engine.py::test_mesh_matches_
+    single_device), so only the pre-bifurcation horizon is bit-comparable."""
+    import shutil
+    from jax.sharding import Mesh
+
+    _, _, x, _ = problem
+    x8 = np.concatenate([x, x])
+    mesh = Mesh(np.array(jax.devices()[:8]), ("states",))
+
+    reference = _engine(problem, None).generate(x8)
+
+    # crash a meshless run right after the generation-8 boundary: one
+    # generation remains after resume (n_gen=10 -> 9 scan steps)
+    cp_path = str(tmp_path / "cp.npz")
+    crashed = _engine(
+        problem, None, checkpoint_every=4, checkpoint_path=cp_path
+    )
+    _crash_on_call(crashed, 3)
+    with pytest.raises(_InjectedCrash):
+        crashed.generate(x8)
+    assert os.path.exists(cp_path)
+    cp_copy = str(tmp_path / "cp_copy.npz")
+    shutil.copy(cp_path, cp_copy)  # completion clears the file; keep a twin
+
+    # resume meshless: must match the uninterrupted run bit for bit
+    resumed_1 = _engine(
+        problem, None, checkpoint_every=4, checkpoint_path=cp_path
+    ).generate(x8)
+    np.testing.assert_array_equal(resumed_1.x_gen, reference.x_gen)
+    np.testing.assert_array_equal(resumed_1.f, reference.f)
+
+    # resume the SAME checkpoint under the 8-device mesh, with a
+    # non-vacuity guard: a fingerprint mismatch would silently restart from
+    # generation 0, which is exactly the failure this test must catch
+    shutil.copy(cp_copy, cp_path)
+    resumed_engine = _engine(
+        problem, None, mesh=mesh, checkpoint_every=4, checkpoint_path=cp_path
+    )
+    resumed_engine._jit_init = jax.jit(resumed_engine._build_init())
+    real_segment = jax.jit(
+        resumed_engine._build_segment(), static_argnames="length"
+    )
+    executed = {"gens": 0}
+
+    def counting(*args, **kwargs):
+        executed["gens"] += kwargs["length"]
+        return real_segment(*args, **kwargs)
+
+    resumed_engine._jit_segment = counting
+    resumed_m = resumed_engine.generate(x8)
+    assert executed["gens"] == 1, (
+        f"mesh resume must continue from generation 8, not restart "
+        f"(executed {executed['gens']} of 9 steps)"
+    )
+    np.testing.assert_array_equal(resumed_m.x_gen, resumed_1.x_gen)
+    np.testing.assert_allclose(resumed_m.f, resumed_1.f, rtol=0, atol=1e-12)

@@ -83,9 +83,29 @@ class SatAttack:
     n_sample: int = 1
     n_jobs: int = 1
     time_limit: float | None = 30.0
+    #: iterative grid refinement for builders that search nonlinear
+    #: participants over candidate grids (LCLD's ratio denominators): after a
+    #: successful solve the builder is re-invoked with the incumbent solution
+    #: as ``focus`` and a geometrically shrinking ``window`` (¼, ¹⁄₁₆, … of
+    #: the box per round), re-gridding around the incumbent. The incumbent's
+    #: grid values are always kept, so each round's program contains the
+    #: previous optimum and the objective improves monotonically — after r
+    #: rounds the effective denominator resolution is box/4^(r+1) per round
+    #: chain vs the reference's continuous nonconvex search
+    #: (``sat.py:167-173`` NonConvex=2). Ignored for builders without a
+    #: ``focus`` parameter (botnet: fully linear, nothing to refine).
+    refine_rounds: int = 0
 
     def __post_init__(self):
         validate_norm(self.norm)
+        import inspect
+
+        try:
+            self._builder_refines = "focus" in inspect.signature(
+                self.sat_rows_builder
+            ).parameters
+        except (TypeError, ValueError):
+            self._builder_refines = False
         schema = self.constraints.schema
         # int/ohe features become MILP integer variables; real and softmax
         # (simplex) features stay continuous
@@ -132,54 +152,28 @@ class SatAttack:
             weights = movable.astype(float)
         return self.eps * weights / np.linalg.norm(weights)
 
-    def _one_generate(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
-        from scipy import optimize, sparse
-
-        d = x_init.shape[0]
-        xl, xu = self.constraints.get_feature_min_max(dynamic_input=x_init)
-        xl = np.asarray(xl, dtype=float).copy()
-        xu = np.asarray(xu, dtype=float).copy()
-
-        radius = self._box_radii(x_init, hot)
-        s_init = x_init * self._scale + self._min
-        nonzero = self._scale != 0
-        lo_box = np.where(
-            nonzero, (s_init - radius + SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xl
-        )
-        hi_box = np.where(
-            nonzero, (s_init + radius - SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xu
-        )
-        xl = np.maximum(xl, lo_box)
-        xu = np.minimum(xu, hi_box)
-
-        # immutability as bound pins (sat.py:56-61)
-        xl[~self._mutable] = x_init[~self._mutable]
-        xu[~self._mutable] = x_init[~self._mutable]
-
-        # builders receive the ε-intersected feature box so they can
-        # grid-search nonlinear participants inside it
-        spec = self.sat_rows_builder(x_init, hot, (xl.copy(), xu.copy()))
-        if not spec.feasible:
-            return np.tile(x_init, (self.n_sample, 1))
+    def _assemble(self, spec: LinearRows, xl: np.ndarray, xu: np.ndarray, hot: np.ndarray):
+        """LinearRows -> the HiGHS program matrices, or None when a hard pin
+        falls outside the ε-box ∩ feature bounds (the mode is unreachable
+        within the budget: genuinely infeasible, never silently escaped)."""
+        d = xl.shape[0]
+        xl, xu = xl.copy(), xu.copy()
+        rows = list(spec.rows)
         if len(self._softmax_idx):
-            spec.rows.append(
+            rows.append(
                 (self._softmax_idx, np.ones(len(self._softmax_idx)), 1.0, 1.0)
             )
-        # Pins must stay inside the ε-box ∩ feature bounds: a pin outside it
-        # means the mode choice is unreachable within the budget — the
-        # program is genuinely infeasible and we fall back to x_init
-        # (sat.py:184-185) rather than silently escaping the ball.
         tol = 1e-9
         for i, v in spec.fixes.items():
             if v < xl[i] - tol or v > xu[i] + tol:
-                return np.tile(x_init, (self.n_sample, 1))
+                return None
             xl[i] = xu[i] = min(max(v, xl[i]), xu[i])
 
         # variable layout: [x (d features), z (e mode binaries), p, n (split)]
         e = spec.n_extra_bin
-        n_rows = len(spec.rows)
+        n_rows = len(rows)
         a_rows, lo_r, hi_r = [], [], []
-        for cols, coefs, lo, hi in spec.rows:
+        for cols, coefs, lo, hi in rows:
             row = np.zeros(d + e)
             row[np.asarray(cols, dtype=int)] = np.asarray(coefs, dtype=float)
             a_rows.append(row)
@@ -225,22 +219,42 @@ class SatAttack:
         lo_int = np.ceil(xl_full[: d + e] - 1e-9)
         hi_int = np.floor(xu_full[: d + e] + 1e-9)
         is_bin = (integrality[: d + e] == 1) & (lo_int == 0.0) & (hi_int == 1.0)
-        bin_idx = np.flatnonzero(is_bin)
+        return {
+            "d": d,
+            "e": e,
+            "a": a_full,
+            "lo": lo_full,
+            "hi": hi_full,
+            "c": c,
+            "xl": xl_full,
+            "xu": xu_full,
+            "integrality": integrality,
+            "bin_idx": np.flatnonzero(is_bin),
+        }
 
+    def _solve_pool(self, prog: dict, n_sample: int) -> list[np.ndarray]:
+        """Solve, emulating Gurobi's solution pool with no-good cuts over the
+        program's binary variables (``sat.py:167-173``)."""
+        from scipy import optimize, sparse
+
+        d, e = prog["d"], prog["e"]
+        a_full, lo_full, hi_full = prog["a"], prog["lo"], prog["hi"]
+        bin_idx = prog["bin_idx"]
+        n_var = a_full.shape[1]
         options = {}
         if self.time_limit is not None:
             options["time_limit"] = self.time_limit
 
         sols: list[np.ndarray] = []
-        for _ in range(self.n_sample):
+        for _ in range(n_sample):
             cons = optimize.LinearConstraint(
                 sparse.csr_matrix(a_full), lo_full, hi_full
             )
             res = optimize.milp(
-                c,
+                prog["c"],
                 constraints=cons,
-                bounds=optimize.Bounds(xl_full, xu_full),
-                integrality=integrality,
+                bounds=optimize.Bounds(prog["xl"], prog["xu"]),
+                integrality=prog["integrality"],
                 options=options,
             )
             if not res.success or res.x is None:
@@ -248,7 +262,7 @@ class SatAttack:
             out = res.x[:d]
             out = np.where(self._int_mask, np.round(out), out)
             sols.append(out)
-            if len(sols) == self.n_sample or len(bin_idx) == 0:
+            if len(sols) == n_sample or len(bin_idx) == 0:
                 break
             # no-good cut: at least one binary flips vs this assignment —
             # sum_{b=0} x_b + sum_{b=1} (1 - x_b) >= 1
@@ -258,9 +272,64 @@ class SatAttack:
             a_full = np.vstack([a_full, row[None, :]])
             lo_full = np.concatenate([lo_full, [1.0 - assign.sum()]])
             hi_full = np.concatenate([hi_full, [np.inf]])
+        return sols
+
+    def _one_generate(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
+        xl, xu = self.constraints.get_feature_min_max(dynamic_input=x_init)
+        xl = np.asarray(xl, dtype=float).copy()
+        xu = np.asarray(xu, dtype=float).copy()
+
+        radius = self._box_radii(x_init, hot)
+        s_init = x_init * self._scale + self._min
+        nonzero = self._scale != 0
+        lo_box = np.where(
+            nonzero, (s_init - radius + SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xl
+        )
+        hi_box = np.where(
+            nonzero, (s_init + radius - SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xu
+        )
+        xl = np.maximum(xl, lo_box)
+        xu = np.minimum(xu, hi_box)
+
+        # immutability as bound pins (sat.py:56-61)
+        xl[~self._mutable] = x_init[~self._mutable]
+        xu[~self._mutable] = x_init[~self._mutable]
+        box = (xl.copy(), xu.copy())
+
+        fallback = np.tile(x_init, (self.n_sample, 1))
+        # builders receive the ε-intersected feature box so they can
+        # grid-search nonlinear participants inside it
+        spec = self.sat_rows_builder(x_init, hot, box)
+        if not spec.feasible:
+            return fallback
+        prog = self._assemble(spec, xl, xu, hot)
+        if prog is None:
+            return fallback
+
+        refining = self.refine_rounds > 0 and self._builder_refines
+        sols = self._solve_pool(prog, 1 if refining else self.n_sample)
+        if sols and refining:
+            # grid refinement: re-centre the builder's candidate grids on the
+            # incumbent with a shrinking window; the incumbent always stays
+            # in the refined grid, so each round's optimum is no worse
+            for r in range(self.refine_rounds):
+                spec_r = self.sat_rows_builder(
+                    x_init, hot, box, focus=sols[0], window=0.25 ** (r + 1)
+                )
+                if not spec_r.feasible:
+                    break
+                prog_r = self._assemble(spec_r, xl, xu, hot)
+                if prog_r is None:
+                    break
+                sols_r = self._solve_pool(prog_r, 1)
+                if not sols_r:
+                    break
+                prog, sols = prog_r, sols_r
+            if self.n_sample > 1:
+                sols = self._solve_pool(prog, self.n_sample) or sols
 
         if not sols:
-            return np.tile(x_init, (self.n_sample, 1))  # sat.py:184-185
+            return fallback  # sat.py:184-185
         while len(sols) < self.n_sample:
             sols.append(sols[-1])  # binary space exhausted: pad
         return np.stack(sols)

@@ -22,7 +22,11 @@ HiGHS formulation:
   big-M rows with benign magnitudes. This is the same mode-search
   architecture as the term switch; the reference instead hands Gurobi the
   nonconvex bilinear rows directly (``NonConvex=2``), so its search is
-  continuous where ours is gridded — the documented residual gap.
+  continuous where ours is gridded. ``SatAttack.refine_rounds`` narrows that
+  gap iteratively: each round re-grids the denominators around the incumbent
+  solution with a ¼-shrinking window (monotone — the incumbent stays in the
+  grid), reaching box/64 resolution after two rounds; the residual gap is
+  the finite final resolution.
 - pub_rec and both date features are pinned at hot-start values — **exact**
   pins, those features are immutable in the schema — so g7 fixes the
   month-difference feature and g8/g9/g10 are linear. A zero month
@@ -77,13 +81,27 @@ def _denominator_grid(
     return out
 
 
-def make_lcld_sat_builder(schema: FeatureSchema):
+def make_lcld_sat_builder(schema: FeatureSchema, grid_points: int = 5):
+    """``grid_points`` sets the denominator-grid density (default 5; the
+    refinement loop makes denser initial grids unnecessary in production —
+    the dense setting exists as a brute-force oracle for tests)."""
     ohe_groups = [np.asarray(g) for g in schema.ohe_groups()]
     d = schema.n_features
 
     def build(
-        x_init: np.ndarray, hot: np.ndarray, box: tuple | None = None
+        x_init: np.ndarray,
+        hot: np.ndarray,
+        box: tuple | None = None,
+        focus: np.ndarray | None = None,
+        window: float = 1.0,
     ) -> LinearRows:
+        """``focus``/``window`` drive the engine's iterative grid refinement
+        (``SatAttack.refine_rounds``): with a focus solution, each searched
+        denominator re-grids over ``window``·(box width) centred on the
+        incumbent value — which is always kept in the grid, so refinement is
+        monotone. Two rounds take the denominator resolution from box/4 to
+        box/64, closing most of the gap to Gurobi's continuous nonconvex
+        search (``lcld_constraints_sat.py:33-36``)."""
         rows = []
         fixes = {}
 
@@ -136,7 +154,21 @@ def make_lcld_sat_builder(schema: FeatureSchema):
             the numerator is the linear form num_cols·num_coefs (|·| ≤ num_hi).
             Returns False when no admissible denominator value exists."""
             nonlocal n_bin
-            grid = _denominator_grid(hot[den], x_init[den], box_lo[den], box_hi[den])
+            if focus is None:
+                grid = _denominator_grid(
+                    hot[den], x_init[den], box_lo[den], box_hi[den],
+                    n=grid_points,
+                )
+            else:
+                v_star = float(focus[den])
+                half = window * (box_hi[den] - box_lo[den]) / 2.0
+                grid = _denominator_grid(
+                    v_star,
+                    v_star,
+                    max(box_lo[den], v_star - half),
+                    min(box_hi[den], v_star + half),
+                    n=grid_points,
+                )
             if not grid:
                 return False
             base = d + n_bin

@@ -109,6 +109,9 @@ def run(config: dict):
                 np.inf,
                 n_sample=1,
                 n_jobs=config.get("system", {}).get("n_jobs", 1),
+                # iterative denominator-grid refinement (no-op for fully
+                # linear domains); 2 rounds ~ box/64 resolution
+                refine_rounds=int(config.get("sat_refine_rounds", 2)),
             )
             x_attacks = sat.generate(x_initial, x_attacks)[:, 0, :]
 

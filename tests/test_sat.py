@@ -322,3 +322,62 @@ class TestLcldModeSearchAndPool:
         hot[:, 9] = hot[:, 7]  # earliest_cr_line == issue_d -> diff = 0
         out = self._attack(cons, scaler).generate(x, hot_start=hot)[:, 0, :]
         np.testing.assert_allclose(out, x)
+
+
+class TestGridRefinement:
+    """`refine_rounds` vs a dense-grid brute-force oracle (VERDICT r3 item 6:
+    the 5-point denominator grids were the one place the rebuild was strictly
+    less capable than Gurobi's continuous nonconvex search)."""
+
+    def _objective(self, scaler, mutable, sol, hot):
+        w = np.abs(np.asarray(scaler.scale))
+        w = np.where(w == 0, 1.0, w)
+        return float(np.sum(w[mutable] * np.abs((sol - hot)[mutable])))
+
+    def test_refined_matches_dense_grid_oracle(self, lcld_setup):
+        cons, x, scaler = lcld_setup
+        mutable = np.asarray(cons.schema.mutable, bool)
+        rng = np.random.default_rng(9)
+
+        # Hot starts engineered so the cheapest repair needs an *off-grid*
+        # denominator: annual_inc displaced beyond the ε-box (the grid's
+        # hot candidate clamps to the box edge) while the recorded ratio is
+        # consistent with an interior denominator between base grid points.
+        hot = x.copy()
+        hot[:, 6] = x[:, 6] * (1.0 + rng.uniform(0.3, 0.6, len(x)))
+        den_star = x[:, 6] * (1.0 + rng.uniform(0.04, 0.11, len(x)))
+        hot[:, 20] = x[:, 0] / den_star
+
+        def attack(refine_rounds, grid_points=5):
+            return SatAttack(
+                constraints=cons,
+                sat_rows_builder=make_lcld_sat_builder(
+                    cons.schema, grid_points=grid_points
+                ),
+                min_max_scaler=scaler,
+                eps=0.2,
+                norm=np.inf,
+                refine_rounds=refine_rounds,
+            )
+
+        base = attack(0).generate(x, hot_start=hot)[:, 0, :]
+        refined = attack(2).generate(x, hot_start=hot)[:, 0, :]
+        dense = attack(0, grid_points=129).generate(x, hot_start=hot)[:, 0, :]
+        for out in (base, refined, dense):
+            cons.check_constraints_error(out)
+
+        obj_b = [self._objective(scaler, mutable, base[i], hot[i]) for i in range(len(x))]
+        obj_r = [self._objective(scaler, mutable, refined[i], hot[i]) for i in range(len(x))]
+        obj_d = [self._objective(scaler, mutable, dense[i], hot[i]) for i in range(len(x))]
+
+        for i in range(len(x)):
+            # monotone: the incumbent stays in every refined grid
+            assert obj_r[i] <= obj_b[i] + 1e-9, (i, obj_r[i], obj_b[i])
+            # within noise of the 129-point brute-force oracle (refined
+            # resolution box/64 ~ oracle spacing box/128)
+            assert obj_r[i] <= obj_d[i] + 0.05 * max(obj_d[i], 1e-6) + 1e-6, (
+                i, obj_r[i], obj_d[i],
+            )
+        # the construction must actually exercise refinement: at least one
+        # state strictly improves on the 5-point grid
+        assert any(r < b - 1e-6 for r, b in zip(obj_r, obj_b)), (obj_r, obj_b)

@@ -46,6 +46,10 @@ def nd_ranks(f: jnp.ndarray, n_stop: int | None = None) -> jnp.ndarray:
     if n_stop is None:
         n_stop = n
     dom = domination_matrix(f)
+    # bf16 operands with f32 accumulation keep the per-column dominator
+    # counts exact (0/1 inputs, counts < 2^24) while the contraction runs on
+    # the MXU instead of a VPU masked reduction
+    dom_bf = dom.astype(jnp.bfloat16)
 
     ranks0 = jnp.full(f.shape[:-1], UNRANKED, dtype=jnp.int32)
 
@@ -60,7 +64,12 @@ def nd_ranks(f: jnp.ndarray, n_stop: int | None = None) -> jnp.ndarray:
         remaining = ranks == UNRANKED
         done = (~remaining).sum(-1, keepdims=True) >= n_stop
         # dominators still unranked, per candidate j
-        n_dom = (dom & remaining[..., :, None]).sum(-2)
+        n_dom = jnp.einsum(
+            "...i,...ij->...j",
+            remaining.astype(jnp.bfloat16),
+            dom_bf,
+            preferred_element_type=jnp.float32,
+        )
         front = remaining & (n_dom == 0)
         # Safety: if nothing peels (cannot happen for finite f), mark all to
         # terminate rather than loop forever.

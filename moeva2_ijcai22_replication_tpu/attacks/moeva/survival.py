@@ -28,6 +28,17 @@ from .nds import nd_ranks
 _BIG = 1e16
 
 
+def _rowsum(mask: jnp.ndarray) -> jnp.ndarray:
+    """Exact int32 row sums of a boolean matrix via an MXU matmul: bf16 0/1
+    operands with f32 accumulation are exact for counts < 2^24, and the
+    (M, M)·(M,) contraction rides the systolic array instead of a VPU masked
+    reduction (the M² comparison counts are survival's densest reductions)."""
+    one = jnp.ones((mask.shape[-1],), jnp.bfloat16)
+    return jnp.matmul(
+        mask.astype(jnp.bfloat16), one, preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+
+
 class NormState(NamedTuple):
     """Per-state normalisation memory carried across generations."""
 
@@ -203,12 +214,59 @@ def _associate_pallas(n, d, interpret=False):
     return rmin[:, 0], niche[:, 0]
 
 
+def _associate_blocked(n, d, block=64):
+    """Association without the (S, M, R) HBM temporary: scan over direction
+    blocks keeping only the running (best proj², argmax) per candidate.
+
+    ``argmin_r dist²`` equals ``argmax_r proj²`` (dist² = |n|² − proj², |n|²
+    constant in r), so the scan tracks the maximal squared projection; the
+    update keeps the earlier index on exact ties, preserving ``jnp.argmin``'s
+    first-index semantics bit for bit. dist at the winner is reconstructed
+    as sqrt(|n|² − best proj²) — the same subtraction of the same floats the
+    one-shot formulation performs."""
+    s, m, k = n.shape
+    r = d.shape[1]
+    nb = -(-r // block)
+    pad = nb * block - r
+    d_pad = jnp.pad(d, ((0, 0), (0, pad), (0, 0)))
+    d_blocks = d_pad.reshape(s, nb, block, k).transpose(1, 0, 2, 3)
+    valid = (jnp.arange(nb * block) < r).reshape(nb, block)
+
+    def body(carry, blk):
+        best_p2, best_i = carry
+        d_blk, valid_blk, base = blk
+        proj = jnp.einsum("smk,sbk->smb", n, d_blk)
+        p2 = jnp.where(valid_blk[None, None, :], proj * proj, -jnp.inf)
+        i_blk = jnp.argmax(p2, axis=2).astype(jnp.int32)  # first max in block
+        p2_blk = jnp.take_along_axis(p2, i_blk[..., None], 2)[..., 0]
+        take = p2_blk > best_p2  # strict: earlier blocks win ties
+        return (
+            jnp.where(take, p2_blk, best_p2),
+            jnp.where(take, base + i_blk, best_i),
+        ), None
+
+    init = (
+        jnp.full((s, m), -jnp.inf, n.dtype),
+        jnp.zeros((s, m), jnp.int32),
+    )
+    bases = jnp.arange(nb, dtype=jnp.int32) * block
+    (best_p2, niche), _ = jax.lax.scan(
+        body, init, (d_blocks, valid, bases)
+    )
+    dist2 = (n * n).sum(-1) - best_p2
+    return niche, jnp.sqrt(jnp.clip(dist2, 0.0, None))
+
+
 def associate_batch(
     f, dirs, ideal, nadir, use_pallas=False, interpret=False,
-    mesh=None, states_axis="states",
+    mesh=None, states_axis="states", block=None,
 ):
     """Batched niche association over the states axis: every input carries a
     leading (S,) dim. Returns ``(niche (S, M), dist (S, M))``.
+
+    ``block``: use the blocked-scan formulation (peak memory (S, M, block)
+    instead of the (S, M, R) distance tensor) — bit-identical to the one-shot
+    einsum path.
 
     With ``mesh``, the Pallas kernel is wrapped in ``jax.shard_map`` over the
     states axis (states are independent, so no collectives) — pallas_call
@@ -231,6 +289,8 @@ def associate_batch(
         rmin, niche = kernel(n.astype(jnp.float32), d.astype(jnp.float32))
         dist = jnp.sqrt(jnp.clip(rmin, 0.0, None)).astype(f.dtype)
         return niche, dist
+    if block:
+        return _associate_blocked(n, d, block=block)
     proj = jnp.einsum("smk,srk->smr", n, d)
     dist2 = (n * n).sum(-1)[:, :, None] - proj * proj
     niche = jnp.argmin(dist2, axis=2)
@@ -261,7 +321,7 @@ def _niching_fill(key, ranks, split_rank, niche, dist, niche_count, n_remaining,
     member = niche[:, None] == jnp.arange(r)[None, :]  # (M, R)
     avail = ranks == split_rank  # (M,)
     member_avail = member & avail[:, None]  # (M, R)
-    cap = member_avail.sum(0)  # (R,) members available per niche
+    cap = _rowsum(member_avail.T)  # (R,) members available per niche
     c0 = niche_count
 
     def filled(level):
@@ -299,9 +359,9 @@ def _niching_fill(key, ranks, split_rank, niche, dist, niche_count, n_remaining,
         is_closest & avail, -jnp.inf, jax.random.gumbel(k_member, (m,))
     )
     same_niche = niche[:, None] == niche[None, :]  # (M, M)
-    rank_in_niche = (
+    rank_in_niche = _rowsum(
         same_niche & avail[None, :] & (pick_key[None, :] < pick_key[:, None])
-    ).sum(-1)
+    )
     return avail & (rank_in_niche < quota[niche])
 
 
@@ -346,9 +406,8 @@ def _survive_post(key, f, ranks, niche, dist, n_dirs, n_survive):
     single MXU-friendly reductions. Keep the matmuls.
     """
     m = f.shape[0]
-    one = jnp.ones((m,), jnp.int32)
-    cum_le = (ranks[None, :] <= ranks[:, None]).astype(jnp.int32) @ one  # per i: #{j: rank_j <= rank_i}
-    cum_lt = (ranks[None, :] < ranks[:, None]).astype(jnp.int32) @ one
+    cum_le = _rowsum(ranks[None, :] <= ranks[:, None])  # per i: #{j: rank_j <= rank_i}
+    cum_lt = _rowsum(ranks[None, :] < ranks[:, None])
     full_survivor = cum_le <= n_survive  # candidate's whole front fits
     is_split = (cum_lt < n_survive) & ~full_survivor  # candidate's front splits
     # With an exact front-boundary fit there is no splitting front:
@@ -361,7 +420,7 @@ def _survive_post(key, f, ranks, niche, dist, n_dirs, n_survive):
     n_remaining = jnp.maximum(n_survive - n_until, 0)
 
     member = niche[:, None] == jnp.arange(n_dirs)[None, :]
-    niche_count = (member & full_survivor[:, None]).sum(0)
+    niche_count = _rowsum((member & full_survivor[:, None]).T)
 
     taken = _niching_fill(
         key, ranks, split_rank, niche, dist, niche_count, n_remaining, n_survive

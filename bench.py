@@ -138,10 +138,6 @@ def run_real_botnet() -> dict | None:
             classifier=sur, constraints=cons, ml_scaler=scaler,
             norm=2, n_gen=n_gen, n_pop=200, n_offsprings=100, seed=42,
             archive_size=24,  # the production default (config/moeva.yaml)
-            # Pallas opt-in only for the exact validated program (387 states
-            # x pop 203 x 100 gens — tools/validate_pallas.py); env-altered
-            # budgets fall back to the engine default
-            use_pallas=True if n_gen == 100 else None,
         )
         t0 = time.time()
         res = moeva.generate(x, minimize_class=1)
@@ -211,12 +207,6 @@ def main():
     moeva = Moeva2(
         classifier=sur, constraints=cons, ml_scaler=scaler,
         norm=2, n_gen=N_GEN, n_pop=N_POP, n_offsprings=N_OFF, seed=42,
-        # Pallas opt-in only for the exact validated program (1000 states x
-        # pop 103 x 1000 gens — tools/validate_pallas.py); env-altered
-        # smoke runs fall back to the engine default
-        use_pallas=(
-            True if (N_STATES == 1000 and N_POP == 100 and N_GEN == 1000) else None
-        ),
     )
 
     t0 = time.time()

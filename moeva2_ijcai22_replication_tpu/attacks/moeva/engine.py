@@ -91,18 +91,13 @@ class Moeva2:
     #: in the generation budget: converged late populations can no longer
     #: lose the constrained adversarials found mid-run.
     archive_size: int = 0
-    #: niche-association backend. The Pallas kernel is ~20% faster on the
-    #: survival stage and bit-validated against the XLA path, but some
-    #: compiled configurations fault the TPU *worker process*: the whole
-    #: experiment dies and the backend is unusable until process restart.
-    #: The fault is program-dependent, not shape-alone (537 LCLD states
-    #: passes at n_gen=5 and faults at n_gen=50; 387 botnet and 1000 LCLD
-    #: pass at production budgets), so a wrong auto-enable costs far more
-    #: than the speedup. Default (None) therefore resolves to the XLA path;
-    #: opt in per-call with True on configurations validated by
-    #: ``tools/validate_pallas.py`` (bench.py does), or globally with
-    #: MOEVA_ENABLE_PALLAS=1.
-    use_pallas: bool | None = None
+    #: niche-association formulation: None = one-shot einsum; an int = the
+    #: blocked scan with that direction-block size (peak memory
+    #: (S, M, block) instead of the (S, M, R) distance tensor) —
+    #: bit-identical results either way. A hand-written Pallas kernel for
+    #: this stage was removed as a recorded negative result (it could crash
+    #: the TPU worker process at specific state counts; docs/DESIGN.md §3).
+    assoc_block: int | None = None
     save_history: str | None = None
     #: generations per jitted scan segment when history is recorded; each
     #: segment's records are offloaded to host so "full" history at rq1 scale
@@ -149,16 +144,6 @@ class Moeva2:
             )
         self._jit_init = None
         self._jit_segment = None
-        # Pallas-fused niche association is opt-in (see the use_pallas
-        # docstring: the kernel can fault the TPU worker at some state
-        # counts); only meaningful on the TPU backend either way.
-        import os
-
-        if self.use_pallas is None:
-            enabled = os.environ.get("MOEVA_ENABLE_PALLAS", "") not in ("", "0")
-        else:
-            enabled = bool(self.use_pallas)
-        self._use_pallas = enabled and jax.default_backend() == "tpu"
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
@@ -238,8 +223,7 @@ class Moeva2:
             norm0 = jax.vmap(lambda _: NormState.init(3, eng.dtype))(jnp.arange(s))
             _, norm_state, _ = survive_batch(
                 jax.random.split(k0, s), pop_f, asp, norm0, pop_size,
-                use_pallas=eng._use_pallas, mesh=eng.mesh,
-                states_axis=eng.states_axis,
+                assoc_block=eng.assoc_block,
             )
 
             # archive seeded with the elite of the FULL initial population
@@ -313,8 +297,7 @@ class Moeva2:
 
                 mask, norm_state, _ = survive_batch(
                     jax.random.split(k_surv, s), merged_f, asp, norm_state,
-                    pop_size, use_pallas=eng._use_pallas, mesh=eng.mesh,
-                    states_axis=eng.states_axis,
+                    pop_size, assoc_block=eng.assoc_block,
                 )
 
                 # Dense survivor extraction, stable order survivors-first:

@@ -17,7 +17,6 @@ updates (the only inherently sequential part of the algorithm).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -168,51 +167,15 @@ def _associate(f, dirs, ideal, nadir):
 
 # -- batched association (the survival hot spot) ----------------------------
 # Association materialises (S, M, R) distance tensors; XLA's lowering keeps
-# several such temporaries in HBM. The Pallas kernel computes each state's
-# (M, R) block entirely in VMEM — one matmul, square, min — and writes only
-# the (M,) minima, so HBM traffic drops to the inputs/outputs.
-
-def _assoc_kernel(n_ref, d_ref, min_ref, niche_ref):
-    n = n_ref[0]  # (M, n_obj)
-    d = d_ref[0]  # (R, n_obj)
-    r = d.shape[0]
-    proj = jnp.dot(n, d.T, preferred_element_type=jnp.float32)  # (M, R)
-    n2 = (n * n).sum(-1, keepdims=True)
-    dist2 = n2 - proj * proj
-    rmin = dist2.min(axis=1, keepdims=True)
-    # first-index argmin (ties -> smallest index, jnp.argmin semantics)
-    iota = jax.lax.broadcasted_iota(jnp.int32, dist2.shape, 1)
-    niche = jnp.where(dist2 == rmin, iota, r).min(axis=1)
-    min_ref[0, 0] = rmin[:, 0]
-    niche_ref[0, 0] = niche
-
-
-def _associate_pallas(n, d, interpret=False):
-    """(S, M, k), (S, R, k) unit-normalised -> ((S, M) min dist², (S, M) niche)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    s, m, k = n.shape
-    r = d.shape[1]
-    rmin, niche = pl.pallas_call(
-        _assoc_kernel,
-        grid=(s,),
-        in_specs=[
-            pl.BlockSpec((1, m, k), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, r, k), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=(
-            jax.ShapeDtypeStruct((s, 1, m), jnp.float32),
-            jax.ShapeDtypeStruct((s, 1, m), jnp.int32),
-        ),
-        out_specs=(
-            pl.BlockSpec((1, 1, m), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, m), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-        ),
-        interpret=interpret,
-    )(n, d)
-    return rmin[:, 0], niche[:, 0]
-
+# several such temporaries in HBM. The blocked-scan formulation below keeps
+# the working set at (S, M, block). A hand-written Pallas kernel for this
+# stage was REMOVED as a recorded negative result: it intermittently crashed
+# the TPU *worker process* at specific state counts (round-4 bisection:
+# 537/538/540/544 states fault repeatably at every n_gen probed, 64/387→392/
+# 512/520/1000 run clean — no mod-8, VMEM, scan-length, or invocation-count
+# predicate survived probing, and the round-3 "validated shapes" were shown
+# to be luck). A ~15% end-to-end win is not worth an unpredictable fault
+# that kills the whole experiment and backend; see docs/DESIGN.md §3.
 
 def _associate_blocked(n, d, block=64):
     """Association without the (S, M, R) HBM temporary: scan over direction
@@ -257,38 +220,18 @@ def _associate_blocked(n, d, block=64):
     return niche, jnp.sqrt(jnp.clip(dist2, 0.0, None))
 
 
-def associate_batch(
-    f, dirs, ideal, nadir, use_pallas=False, interpret=False,
-    mesh=None, states_axis="states", block=None,
-):
+def associate_batch(f, dirs, ideal, nadir, block=None):
     """Batched niche association over the states axis: every input carries a
     leading (S,) dim. Returns ``(niche (S, M), dist (S, M))``.
 
     ``block``: use the blocked-scan formulation (peak memory (S, M, block)
     instead of the (S, M, R) distance tensor) — bit-identical to the one-shot
-    einsum path.
-
-    With ``mesh``, the Pallas kernel is wrapped in ``jax.shard_map`` over the
-    states axis (states are independent, so no collectives) — pallas_call
-    does not auto-partition inside pjit, shard_map restores the per-device
-    grid."""
+    einsum path. Both paths are plain jnp, so they partition over a states
+    mesh automatically under pjit (states are independent; no collectives)."""
     denom = nadir - ideal
     denom = jnp.where(denom == 0, 1e-12, denom)
     n = (f - ideal[:, None, :]) / denom[:, None, :]
     d = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
-    if use_pallas:
-        kernel = partial(_associate_pallas, interpret=interpret)
-        if mesh is not None:
-            from jax.sharding import PartitionSpec as P
-
-            spec = P(states_axis)
-            kernel = jax.shard_map(
-                kernel, mesh=mesh, in_specs=(spec, spec),
-                out_specs=(spec, spec), check_vma=False,
-            )
-        rmin, niche = kernel(n.astype(jnp.float32), d.astype(jnp.float32))
-        dist = jnp.sqrt(jnp.clip(rmin, 0.0, None)).astype(f.dtype)
-        return niche, dist
     if block:
         return _associate_blocked(n, d, block=block)
     proj = jnp.einsum("smk,srk->smr", n, d)
@@ -439,7 +382,7 @@ def survive(
 
     Returns ``(survive_mask (M,) bool — exactly n_survive True, new_state,
     ranks)``. vmap over the states axis, or use :func:`survive_batch` for the
-    engine's batched path (same semantics, Pallas-fused association on TPU).
+    engine's batched path (same semantics, selectable association blocking).
     """
     ranks, dirs, nadir, new_state = _survive_pre(f, asp_points, state, n_survive)
     niche, dist = _associate(f, dirs, new_state.ideal, nadir)
@@ -453,21 +396,18 @@ def survive_batch(
     asp_points: jnp.ndarray,  # (A, n_obj)
     state: NormState,  # batched (S, ...) leaves
     n_survive: int,
-    use_pallas: bool = False,
-    interpret: bool = False,
-    mesh=None,
-    states_axis: str = "states",
+    assoc_block: int | None = None,
 ):
     """Batched survival over the states axis — identical semantics to
-    ``vmap(survive)``, with the association step lifted out of the vmap so it
-    can run as one fused Pallas program on TPU (shard_map'd over ``mesh``
-    when the states axis is device-sharded)."""
+    ``vmap(survive)``, with the association step lifted out of the vmap so
+    its formulation (one-shot einsum or blocked scan, ``assoc_block``) can be
+    chosen independently; everything is plain jnp, so a states-sharded mesh
+    partitions it without collectives."""
     ranks, dirs, nadir, new_state = jax.vmap(
         lambda f1, st: _survive_pre(f1, asp_points, st, n_survive)
     )(f, state)
     niche, dist = associate_batch(
-        f, dirs, new_state.ideal, nadir, use_pallas=use_pallas,
-        interpret=interpret, mesh=mesh, states_axis=states_axis,
+        f, dirs, new_state.ideal, nadir, block=assoc_block
     )
     mask = jax.vmap(
         lambda k, f1, r1, ni, di: _survive_post(

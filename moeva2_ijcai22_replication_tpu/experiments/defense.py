@@ -113,11 +113,6 @@ def moeva_attack(model, constraints, ml_scaler, config, x_cand) -> np.ndarray:
         classifier=model, constraints=constraints, ml_scaler=ml_scaler,
         norm=config["norm"], n_gen=config["budget"],
         n_pop=config["n_pop"], n_offsprings=config["n_offsprings"],
-        # hard-pinned XLA association, immune to MOEVA_ENABLE_PALLAS: this
-        # pipeline's candidate counts are data-dependent and cannot be
-        # pre-validated against the Pallas worker fault (engine.use_pallas
-        # docstring; 640 states here was the originally faulting shape)
-        use_pallas=False,
         seed=config["seed"], mesh=mesh,
     ).generate(x_run, 1)
     return result.x_ml[:n]

@@ -100,15 +100,14 @@ timed("C full attack    ", full, params, x_init, mc, xl_ml, xu_ml, key)
 
 @jax.jit
 def scan_survive(pop_x, key):
-    # production path: survive_batch with the pallas association when the
-    # engine would use it (TPU)
+    # production path: survive_batch with the engine's association blocking
     merged = jnp.concatenate([pop_x, pop_x[:, :n_off] * 1.001], axis=1)
     def step(carry, _):
         fpop, k, st = carry
         k, ks = jax.random.split(k)
         mask, st, _ = survive_batch(
             jax.random.split(ks, s), fpop, asp, st, pop_size,
-            use_pallas=moeva._use_pallas,
+            assoc_block=moeva.assoc_block,
         )
         return (fpop + 0.0 * mask.sum(), k, st), ()
     f0, _ = moeva._evaluate(params, merged, x_init, x_init_mm, xl_ml, xu_ml, mc)

@@ -22,12 +22,27 @@ fidelity limits vs the reference:
   exactly this purpose. Immutable nonlinear participants (dates, pub_rec)
   are pinned at hot-start values — exact by immutability — with every
   zero/degenerate pin detected and mapped to the infeasible fallback.
-- The L2 ε-ball (Gurobi pow-constraint, ``sat.py:98-124``) is inscribed by
-  a per-feature box with Σ radius² = ε² — solutions remain valid L2
-  members, the search space is just smaller. The box is directional: radii
-  follow the hot-start displacement, so a PGD-steered repair keeps almost
-  the full ε budget on the features the gradient attack actually moved
-  (uniform ε/√D only in the no-hot-start case).
+- The L2 ε-ball (Gurobi pow-constraint, ``sat.py:98-124``) is solved
+  EXACTLY by outer approximation (``l2_cut_rounds``, the default): the
+  program is relaxed to the circumscribed box (every feature gets the full
+  ε radius), and each incumbent outside the true scaled-L2 ball adds the
+  ball's supporting hyperplane at that direction — a plain linear row — and
+  re-solves. An accepted incumbent lies inside the true ball and minimised
+  the objective over a superset of a (1 − 1e-3)-shrunk ball (L2_CUT_MARGIN),
+  so it is optimal over the exact ball to within a 0.1% radial margin;
+  within the linear solver this closes the reference's quadratic-constraint
+  capability for low-dimensional repair displacements (where Kelley
+  converges in a few cuts — the LCLD family). High-dimensional
+  displacements (botnet's coordinated sum-equality chains) can flatline
+  above the ball — frequently because no in-ball repair exists, which
+  tangent cuts cannot prove — and are abandoned after two stalled rounds.
+  When the cut loop exits without an in-ball incumbent the engine falls
+  back to the previous inscribed-box program:
+  a per-feature box with Σ radius² = ε² (solutions remain valid L2 members,
+  the search space is just smaller), directional — radii follow the
+  hot-start displacement, so a PGD-steered repair keeps almost the full ε
+  budget on the features the gradient attack actually moved (uniform ε/√D
+  only in the no-hot-start case).
 - Gurobi's solution pool (PoolSolutions=n_sample, ``sat.py:167-173``) is
   emulated with no-good cuts over the program's binary variables (one-hot
   members, mode binaries): each re-solve excludes all previous binary
@@ -52,6 +67,14 @@ from ...core.norms import is_inf, validate_norm
 from ...models.scalers import MinMaxParams
 
 SAFETY_DELTA = 1e-7  # sat.py:18
+#: relative radial margin of the L2 cutting planes: cuts are tangent to a
+#: (1 − margin)-shrunk ball so the cutting-plane incumbents — which approach
+#: the cut ball's boundary FROM OUTSIDE — land strictly inside the true
+#: ε-ball after a few rounds instead of converging to it asymptotically.
+#: Accepted solutions are validated against the full ε (− SAFETY_DELTA), so
+#: the margin costs at most 0.1% of the radius — vs the inscribed box's
+#: (1 − 1/√m) sacrifice on concentrated directions.
+L2_CUT_MARGIN = 1e-3
 
 
 @dataclass
@@ -95,6 +118,14 @@ class SatAttack:
     #: (``sat.py:167-173`` NonConvex=2). Ignored for builders without a
     #: ``focus`` parameter (botnet: fully linear, nothing to refine).
     refine_rounds: int = 0
+    #: outer-approximation rounds for the exact L2 ball (L2 norm only): the
+    #: ε-box is relaxed to the circumscribed box and out-of-ball incumbents
+    #: add supporting-hyperplane cuts until one lands inside the ball (then
+    #: optimal over it up to L2_CUT_MARGIN) or the rounds run out (then the
+    #: inscribed directional box is solved instead — the guaranteed-valid
+    #: fallback).
+    #: 0 disables the cut path entirely.
+    l2_cut_rounds: int = 12
 
     def __post_init__(self):
         validate_norm(self.norm)
@@ -274,59 +305,182 @@ class SatAttack:
             hi_full = np.concatenate([hi_full, [np.inf]])
         return sols
 
-    def _one_generate(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
+    def _eps_box(self, x_init: np.ndarray, radius: np.ndarray):
+        """Feature bounds ∩ per-feature ε-box (scaled space) with
+        immutability pins (sat.py:56-61)."""
         xl, xu = self.constraints.get_feature_min_max(dynamic_input=x_init)
         xl = np.asarray(xl, dtype=float).copy()
         xu = np.asarray(xu, dtype=float).copy()
-
-        radius = self._box_radii(x_init, hot)
         s_init = x_init * self._scale + self._min
         nonzero = self._scale != 0
+        safe_scale = np.where(nonzero, self._scale, 1.0)
         lo_box = np.where(
-            nonzero, (s_init - radius + SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xl
+            nonzero, (s_init - radius + SAFETY_DELTA - self._min) / safe_scale, xl
         )
         hi_box = np.where(
-            nonzero, (s_init + radius - SAFETY_DELTA - self._min) / np.where(nonzero, self._scale, 1.0), xu
+            nonzero, (s_init + radius - SAFETY_DELTA - self._min) / safe_scale, xu
         )
         xl = np.maximum(xl, lo_box)
         xu = np.minimum(xu, hi_box)
-
-        # immutability as bound pins (sat.py:56-61)
         xl[~self._mutable] = x_init[~self._mutable]
         xu[~self._mutable] = x_init[~self._mutable]
-        box = (xl.copy(), xu.copy())
+        return xl, xu
 
+    def _l1_objective(self, hot: np.ndarray):
+        """The program's objective as a host function — scaled L1 distance to
+        the hot start over the mutable features (refinement acceptance)."""
+        mut_idx = np.flatnonzero(self._mutable)
+        w = np.where(self._scale[mut_idx] == 0, 1.0, np.abs(self._scale[mut_idx]))
+
+        def obj(s):
+            return float(w @ np.abs(s[mut_idx] - hot[mut_idx]))
+
+        return obj
+
+    def _ball_cut_rows(self, dirs: list, x_init: np.ndarray) -> list:
+        """Supporting hyperplanes of the (1 − L2_CUT_MARGIN)-shrunk scaled-L2
+        ε-ball: for a unit direction u (scaled space), u·scale·(x − x_init) ≤
+        ρ is valid for every shrunk-ball member and cuts off everything beyond
+        the tangent plane (see L2_CUT_MARGIN for why the shrink)."""
+        eps_eff = (self.eps - SAFETY_DELTA) * (1.0 - L2_CUT_MARGIN)
+        rows = []
+        for u in dirs:
+            coefs = u * self._scale
+            nz = np.flatnonzero(coefs)
+            rows.append(
+                (nz, coefs[nz], -np.inf, eps_eff + float(coefs[nz] @ x_init[nz]))
+            )
+        return rows
+
+    def _ball_norm(self, x: np.ndarray, x_init: np.ndarray) -> float:
+        return float(np.linalg.norm((x - x_init) * self._scale))
+
+    def _solve_ball(self, assemble, x_init: np.ndarray, n_sample: int, dirs: list):
+        """Cutting-plane solve over the exact scaled-L2 ball.
+
+        ``assemble(cut_rows)`` builds the program with the given extra rows;
+        ``dirs`` accumulates cut directions across calls (refinement rounds
+        reuse every cut already found). Returns in-ball solutions, or [] when
+        the loop exhausts ``l2_cut_rounds`` without an in-ball incumbent.
+        Each added cut is strictly violated by the incumbent that produced
+        it, so incumbents never repeat.
+
+        Stall exit: Kelley converges in a handful of cuts when the repair
+        displacement is low-dimensional (the binding subspace is small — the
+        LCLD family), but when the nearest feasible repair moves hundreds of
+        coordinated features OUTSIDE the ball (botnet sum-equality chains),
+        each tangent plane shaves a negligible cap and the incumbent norm
+        flatlines above ε — often because no in-ball repair exists at all,
+        which a cutting-plane loop cannot prove cheaply. Two consecutive
+        rounds without meaningful norm progress abandon the hunt to the
+        caller's fallback instead of burning the full round budget.
+        """
+        eps_tol = self.eps - SAFETY_DELTA
+        prev_nrm, stalled = None, 0
+        for _ in range(self.l2_cut_rounds):
+            prog = assemble(self._ball_cut_rows(dirs, x_init))
+            if prog is None:
+                return []
+            sols = self._solve_pool(prog, 1)
+            if not sols:
+                return []
+            delta = (sols[0] - x_init) * self._scale
+            nrm = float(np.linalg.norm(delta))
+            if nrm <= eps_tol:
+                if n_sample > 1:
+                    pool = self._solve_pool(prog, n_sample)
+                    sols = [
+                        s for s in pool if self._ball_norm(s, x_init) <= eps_tol
+                    ] or sols
+                return sols
+            if prev_nrm is not None and nrm > prev_nrm * (1.0 - 1e-3):
+                stalled += 1
+                if stalled >= 2:
+                    return []
+            else:
+                stalled = 0
+            prev_nrm = nrm
+            dirs.append(delta / nrm)
+        return []
+
+    def _refine(self, solve, x_init, hot, box, spec, sols):
+        """Iterative denominator-grid refinement around the incumbent.
+
+        A refined round's solution is accepted only when its objective does
+        not worsen — the incumbent's grid value can fall to the builder's
+        near-zero filter, in which case the refined program no longer
+        contains the incumbent and its optimum may regress.
+        """
+        obj = self._l1_objective(hot)
+        best = obj(sols[0])
+        for r in range(self.refine_rounds):
+            spec_r = self.sat_rows_builder(
+                x_init, hot, box, focus=sols[0], window=0.25 ** (r + 1)
+            )
+            if not spec_r.feasible:
+                break
+            sols_r = solve(spec_r, 1)
+            if not sols_r or obj(sols_r[0]) > best + 1e-9:
+                break
+            spec, sols, best = spec_r, sols_r, obj(sols_r[0])
+        return spec, sols
+
+    def _one_generate(self, x_init: np.ndarray, hot: np.ndarray) -> np.ndarray:
         fallback = np.tile(x_init, (self.n_sample, 1))
+        d = x_init.shape[0]
+        refining = self.refine_rounds > 0 and self._builder_refines
+
+        # -- exact-ball path (L2): circumscribed box + tangent cuts ---------
+        if not is_inf(self.norm) and self.l2_cut_rounds > 0:
+            xl, xu = self._eps_box(x_init, np.full(d, self.eps))
+            box = (xl.copy(), xu.copy())
+            spec = self.sat_rows_builder(x_init, hot, box)
+            if spec.feasible:
+                dirs: list = []  # cuts persist across refinement rounds
+
+                def solve(spec_i, n):
+                    return self._solve_ball(
+                        lambda cut_rows: self._assemble(
+                            LinearRows(
+                                rows=list(spec_i.rows) + cut_rows,
+                                fixes=spec_i.fixes,
+                                n_extra_bin=spec_i.n_extra_bin,
+                            ),
+                            xl, xu, hot,
+                        ),
+                        x_init, n, dirs,
+                    )
+
+                sols = solve(spec, 1 if refining else self.n_sample)
+                if sols:
+                    if refining:
+                        spec, sols = self._refine(
+                            solve, x_init, hot, box, spec, sols
+                        )
+                        if self.n_sample > 1:
+                            sols = solve(spec, self.n_sample) or sols
+                    while len(sols) < self.n_sample:
+                        sols.append(sols[-1])
+                    return np.stack(sols)
+
+        # -- inscribed directional box (L∞, or the cut loop came up dry) ----
+        xl, xu = self._eps_box(x_init, self._box_radii(x_init, hot))
+        box = (xl.copy(), xu.copy())
         # builders receive the ε-intersected feature box so they can
         # grid-search nonlinear participants inside it
         spec = self.sat_rows_builder(x_init, hot, box)
         if not spec.feasible:
             return fallback
-        prog = self._assemble(spec, xl, xu, hot)
-        if prog is None:
-            return fallback
 
-        refining = self.refine_rounds > 0 and self._builder_refines
-        sols = self._solve_pool(prog, 1 if refining else self.n_sample)
+        def solve_box(spec_i, n):
+            prog = self._assemble(spec_i, xl, xu, hot)
+            return self._solve_pool(prog, n) if prog is not None else []
+
+        sols = solve_box(spec, 1 if refining else self.n_sample)
         if sols and refining:
-            # grid refinement: re-centre the builder's candidate grids on the
-            # incumbent with a shrinking window; the incumbent always stays
-            # in the refined grid, so each round's optimum is no worse
-            for r in range(self.refine_rounds):
-                spec_r = self.sat_rows_builder(
-                    x_init, hot, box, focus=sols[0], window=0.25 ** (r + 1)
-                )
-                if not spec_r.feasible:
-                    break
-                prog_r = self._assemble(spec_r, xl, xu, hot)
-                if prog_r is None:
-                    break
-                sols_r = self._solve_pool(prog_r, 1)
-                if not sols_r:
-                    break
-                prog, sols = prog_r, sols_r
+            spec, sols = self._refine(solve_box, x_init, hot, box, spec, sols)
             if self.n_sample > 1:
-                sols = self._solve_pool(prog, self.n_sample) or sols
+                sols = solve_box(spec, self.n_sample) or sols
 
         if not sols:
             return fallback  # sat.py:184-185

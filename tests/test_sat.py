@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from moeva2_ijcai22_replication_tpu.attacks.sat import SatAttack
+from moeva2_ijcai22_replication_tpu.attacks.sat.engine import LinearRows
 from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
 from moeva2_ijcai22_replication_tpu.domains.botnet_sat import make_botnet_sat_builder
 from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
@@ -322,6 +323,103 @@ class TestLcldModeSearchAndPool:
         hot[:, 9] = hot[:, 7]  # earliest_cr_line == issue_d -> diff = 0
         out = self._attack(cons, scaler).generate(x, hot_start=hot)[:, 0, :]
         np.testing.assert_allclose(out, x)
+
+
+class TestL2ExactBall:
+    """Outer-approximation cuts (``l2_cut_rounds``): the exact scaled-L2 ball
+    vs the inscribed directional box. Reference: Gurobi encodes the ball as a
+    quadratic pow-constraint directly (``sat.py:101-121``); the cut path
+    recovers that capability inside the linear solver."""
+
+    def test_repairs_displacement_the_inscribed_box_rejects(self, lcld_setup):
+        """A constraint forcing a 0.9ε displacement on one feature is L2-ball
+        feasible but far beyond the uniform inscribed radius ε/√m — the cut
+        path must repair it; the box-only attack can only fall back."""
+        cons, x, scaler = lcld_setup
+        eps = 0.2
+        scale = np.asarray(scaler.scale)
+        feat = 12  # revol_bal: mutable, continuous, in no LCLD constraint
+
+        def builder(x_init, hot, box=None):
+            lo = x_init[feat] + 0.9 * eps / scale[feat]
+            return LinearRows(rows=[([feat], [1.0], lo, np.inf)], fixes={})
+
+        def attack(rounds):
+            return SatAttack(
+                constraints=cons, sat_rows_builder=builder,
+                min_max_scaler=scaler, eps=eps, norm=2,
+                l2_cut_rounds=rounds,
+            )
+
+        out = attack(12).generate(x)[:, 0, :]
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        os_ = np.asarray(scaler.transform(jnp.asarray(out)))
+        assert (os_[:, feat] - xs[:, feat]).min() >= 0.9 * eps - 1e-6
+        assert np.linalg.norm(os_ - xs, axis=1).max() <= eps + 1e-6
+        # the inscribed box alone cannot express this repair: x_init fallback
+        np.testing.assert_allclose(attack(0).generate(x)[:, 0, :], x)
+
+    def test_cut_loop_converges_inside_the_ball(self, lcld_setup):
+        """Hot start displaced diagonally BEYOND the ball on two free
+        features: the circumscribed box's first incumbent (= the hot start)
+        is out of ball, so acceptance requires actual cutting-plane rounds.
+        The accepted solution must be ball-valid and no farther from the hot
+        start than the inscribed-box solution."""
+        cons, x, scaler = lcld_setup
+        eps = 0.2
+        scale = np.asarray(scaler.scale)
+        f1, f2 = 12, 13  # revol_bal, revol_util: free continuous mutables
+        hot = x.copy()
+        hot[:, f1] += 0.9 * eps / scale[f1]
+        hot[:, f2] += 0.9 * eps / scale[f2]
+
+        def builder(x_init, h, box=None):
+            return LinearRows(rows=[], fixes={})
+
+        def attack(rounds):
+            return SatAttack(
+                constraints=cons, sat_rows_builder=builder,
+                min_max_scaler=scaler, eps=eps, norm=2,
+                l2_cut_rounds=rounds,
+            )
+
+        out_c = attack(12).generate(x, hot_start=hot)[:, 0, :]
+        out_b = attack(0).generate(x, hot_start=hot)[:, 0, :]
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        hs = np.asarray(scaler.transform(jnp.asarray(hot)))
+        oc = np.asarray(scaler.transform(jnp.asarray(out_c)))
+        ob = np.asarray(scaler.transform(jnp.asarray(out_b)))
+        assert np.linalg.norm(oc - xs, axis=1).max() <= eps + 1e-6
+        assert np.linalg.norm(ob - xs, axis=1).max() <= eps + 1e-6
+        # the cut solution moved meaningfully toward the hot start on both
+        # features (the L1-optimal ball point sits near 0.707ε per feature)
+        assert (oc[:, f1] - xs[:, f1]).min() >= 0.5 * eps
+        assert (oc[:, f2] - xs[:, f2]).min() >= 0.5 * eps
+        l1_c = np.abs(oc - hs).sum(1)
+        l1_b = np.abs(ob - hs).sum(1)
+        assert (l1_c <= l1_b + 1e-4).all(), (l1_c, l1_b)
+
+    def test_production_lcld_l2_still_valid_with_cuts(self, lcld_setup):
+        """The default (cuts-on) LCLD L2 attack repairs a corrupted hot start
+        to full constraint validity without ever leaving the ball."""
+        cons, x, scaler = lcld_setup
+        hot = x.copy()
+        hot[:, 3] += 40.0
+        hot[:, 20] += 0.05
+        atk = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_lcld_sat_builder(cons.schema),
+            min_max_scaler=scaler,
+            eps=0.5,
+            norm=2,
+            refine_rounds=2,
+        )
+        out = atk.generate(x, hot_start=hot)[:, 0, :]
+        g = np.asarray(cons.evaluate(jnp.asarray(out)))
+        assert (g.sum(-1) == 0).all(), g.sum(-1)
+        xs = np.asarray(scaler.transform(jnp.asarray(x)))
+        os_ = np.asarray(scaler.transform(jnp.asarray(out)))
+        assert np.linalg.norm(os_ - xs, axis=1).max() <= 0.5 + 1e-6
 
 
 class TestGridRefinement:

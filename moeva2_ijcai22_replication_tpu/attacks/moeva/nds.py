@@ -11,10 +11,18 @@ vmapped over thousands of independent initial states.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 UNRANKED = jnp.iinfo(jnp.int32).max
+
+#: Count-reduction formulation for the M² comparison reductions here and in
+#: survival (one switch, imported there): matmul counts on the MXU by
+#: default, VPU masked sums via MOEVA_MXU_COUNTS=0 for re-measurement
+#: (round-5 A/B: within noise one-shot — docs/DESIGN.md budget table).
+_MXU_COUNTS = os.environ.get("MOEVA_MXU_COUNTS", "1") != "0"
 
 
 def domination_matrix(f: jnp.ndarray) -> jnp.ndarray:
@@ -59,23 +67,37 @@ def nd_ranks(f: jnp.ndarray, n_stop: int | None = None) -> jnp.ndarray:
             ranks == UNRANKED
         ).any()
 
-    def body(carry):
-        ranks, r = carry
+    def peel(ranks, r):
+        """Assign rank ``r`` to the current front; returns updated ranks."""
         remaining = ranks == UNRANKED
         done = (~remaining).sum(-1, keepdims=True) >= n_stop
         # dominators still unranked, per candidate j
-        n_dom = jnp.einsum(
-            "...i,...ij->...j",
-            remaining.astype(jnp.bfloat16),
-            dom_bf,
-            preferred_element_type=jnp.float32,
-        )
-        front = remaining & (n_dom == 0)
+        if _MXU_COUNTS:
+            n_dom = jnp.einsum(
+                "...i,...ij->...j",
+                remaining.astype(jnp.bfloat16),
+                dom_bf,
+                preferred_element_type=jnp.float32,
+            )
+            front = remaining & (n_dom == 0)
+        else:
+            front = remaining & ~(remaining[..., :, None] & dom).any(-2)
         # Safety: if nothing peels (cannot happen for finite f), mark all to
         # terminate rather than loop forever.
         front = jnp.where(front.any(-1, keepdims=True), front, remaining)
         front = front & ~done  # batch rows past their quota stop updating
-        return jnp.where(front, r, ranks), r + 1
+        return jnp.where(front, r, ranks)
+
+    def body(carry):
+        # Two fronts per trip: the loop cost is dominated by sequential
+        # launch latency of ~n_fronts tiny kernels (per-trip FLOPs are
+        # negligible), so halving the trip count for one extra count-einsum
+        # bounds the worst case. Measured neutral at bench-shape profile
+        # distributions (few fronts there); kept for the many-front tail.
+        ranks, r = carry
+        ranks = peel(ranks, r)
+        ranks = peel(ranks, r + 1)
+        return ranks, r + 2
 
     ranks, _ = jax.lax.while_loop(cond, body, (ranks0, jnp.int32(0)))
     return ranks

@@ -1,0 +1,117 @@
+"""Round-5 on-chip A/B: per-stage budget + the survival formulation knobs.
+
+Measures the FULL production attack program (init + one jitted segment) at
+the bench shape, min-of-N, inside one process per variant (env knobs must be
+set before import). Variants:
+
+  MOEVA_MXU_COUNTS=1|0   matmul vs VPU count reductions (survival + nds)
+  AB_ASSOC_BLOCK=<int>   blocked-scan association (empty = one-shot einsum)
+
+Run me via the driver loop (no args) to sweep all variants in subprocesses,
+or with AB_ONE=1 to measure just the current env's variant.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+N_STATES = int(os.environ.get("AB_STATES", 1000))
+N_GEN = int(os.environ.get("AB_GENS", 60))
+N_POP = int(os.environ.get("AB_POP", 100))
+REPS = int(os.environ.get("AB_REPS", 3))
+
+
+def measure_one():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+    from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+    from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+    if os.environ.get("AB_DOMAIN") == "botnet":
+        from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+
+        b = "/root/reference"
+        cons = BotnetConstraints(
+            f"{b}/data/botnet/features.csv", f"{b}/data/botnet/constraints.csv"
+        )
+        x = np.load(f"{b}/data/botnet/x_candidates_common.npy")
+        sur = load_classifier(f"{b}/models/botnet/nn.model")
+        scaler = load_joblib_scaler(f"{b}/models/botnet/scaler.joblib")
+        n_pop = 200
+    else:
+        from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+        from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+
+        lcld = "/root/reference/data/lcld"
+        cons = LcldConstraints(f"{lcld}/features.csv", f"{lcld}/constraints.csv")
+        x = synth_lcld(N_STATES, cons.schema, seed=42)
+        sur = load_classifier("/root/reference/models/lcld/nn.model")
+        scaler = load_joblib_scaler("/root/reference/models/lcld/scaler.joblib")
+        n_pop = N_POP
+
+    blk = os.environ.get("AB_ASSOC_BLOCK") or None
+    moeva = Moeva2(
+        classifier=sur, constraints=cons, ml_scaler=scaler,
+        norm=2, n_gen=N_GEN, n_pop=n_pop, n_offsprings=100, seed=42,
+        assoc_block=int(blk) if blk else None,
+    )
+    N_STATES_EFF = x.shape[0]
+    xl_ml, xu_ml = cons.get_feature_min_max(dynamic_input=x)
+    xl_ml = np.broadcast_to(np.asarray(xl_ml, float), x.shape)
+    xu_ml = np.broadcast_to(np.asarray(xu_ml, float), x.shape)
+    init_fn = jax.jit(moeva._build_init())
+    seg_fn = jax.jit(moeva._build_segment(), static_argnames="length")
+    args = (
+        sur.params,
+        jnp.asarray(x, moeva.dtype),
+        jnp.ones((N_STATES_EFF,), jnp.int32),
+        jnp.asarray(xl_ml, moeva.dtype),
+        jnp.asarray(xu_ml, moeva.dtype),
+    )
+
+    def run():
+        carry, _ = init_fn(*args, jax.random.PRNGKey(42))
+        carry, _ = seg_fn(*args, carry, length=N_GEN - 1)
+        jax.block_until_ready(carry)
+
+    run()  # compile
+    times = []
+    for _ in range(REPS):
+        t0 = time.time()
+        run()
+        times.append(time.time() - t0)
+    best = min(times)
+    print(
+        f"[ab] mxu={os.environ.get('MOEVA_MXU_COUNTS', '1')} "
+        f"assoc_block={blk or '-'}: {best:.3f}s / {N_GEN} gens = "
+        f"{best / N_GEN * 1e3:.2f} ms/gen  (all: "
+        + " ".join(f"{t:.3f}" for t in times) + ")",
+        flush=True,
+    )
+
+
+def sweep():
+    blocks = os.environ.get("AB_BLOCKS", ",64,128").split(",")
+    variants = [{"MOEVA_MXU_COUNTS": "1", "AB_ASSOC_BLOCK": b} for b in blocks]
+    for v in variants:
+        env = dict(os.environ, AB_ONE="1", **v)
+        r = subprocess.run([sys.executable, __file__], env=env)
+        if r.returncode != 0:
+            print(f"[ab] variant {v} failed rc={r.returncode}", flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("AB_ONE"):
+        measure_one()
+    else:
+        sweep()

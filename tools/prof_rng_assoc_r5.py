@@ -1,0 +1,114 @@
+"""Prototype timings: global-key RNG vs per-state vmapped keys; argmax-p2
+one-shot association vs the current argmin-dist2 formulation."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "./.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+S, M, R, K = 1000, 203, 106, 3
+N_GEN = 60
+rng = np.random.default_rng(0)
+f = jnp.asarray(rng.random((S, M, K)), jnp.float32)
+dirs = jnp.asarray(rng.random((S, R, K)) + 0.1, jnp.float32)
+ideal = jnp.zeros((S, K))
+nadir = jnp.ones((S, K))
+
+
+def timed(name, fn, *args):
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(2):
+        t0 = time.time()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.time() - t0)
+    print(f"{name}: {min(ts)/N_GEN*1e3:.2f} ms/gen", flush=True)
+
+
+def scan(body):
+    @jax.jit
+    def run(key):
+        def step(k, _):
+            k, ks = jax.random.split(k)
+            out = body(ks)
+            return k, out.sum()
+        return jax.lax.scan(step, key, None, length=N_GEN)[1].sum()
+    return run
+
+
+def rng_vmapped(ks):
+    keys = jax.random.split(ks, S)
+    g1 = jax.vmap(lambda k: jax.random.gumbel(k, (R,)))(keys)
+    g2 = jax.vmap(lambda k: jax.random.gumbel(k, (M,)))(keys)
+    return g1.sum() + g2.sum(jnp.float32(0))
+
+
+def rng_vmapped2(ks):
+    keys = jax.random.split(ks, S)
+    g1 = jax.vmap(lambda k: jax.random.gumbel(k, (R,)))(keys)
+    g2 = jax.vmap(lambda k: jax.random.gumbel(k, (M,)))(keys)
+    return g1.sum() + g2.sum()
+
+
+def rng_global(ks):
+    k1, k2 = jax.random.split(ks)
+    g1 = jax.random.gumbel(k1, (S, R))
+    g2 = jax.random.gumbel(k2, (S, M))
+    return g1.sum() + g2.sum()
+
+
+def rng_global_one(ks):
+    g = jax.random.gumbel(ks, (S, R + M))
+    return g.sum()
+
+
+def assoc_current(_):
+    denom = nadir - ideal
+    n = (f - ideal[:, None, :]) / denom[:, None, :]
+    d = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
+    proj = jnp.einsum("smk,srk->smr", n, d)
+    dist2 = (n * n).sum(-1)[:, :, None] - proj * proj
+    niche = jnp.argmin(dist2, axis=2)
+    rmin = jnp.take_along_axis(dist2, niche[..., None], 2)[..., 0]
+    return niche + jnp.sqrt(jnp.clip(rmin, 0.0, None))
+
+
+def assoc_p2(_):
+    denom = nadir - ideal
+    n = (f - ideal[:, None, :]) / denom[:, None, :]
+    d = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
+    proj = jnp.einsum("smk,srk->smr", n, d)
+    p2 = proj * proj
+    niche = jnp.argmax(p2, axis=2)
+    best = jnp.take_along_axis(p2, niche[..., None], 2)[..., 0]
+    dist2 = (n * n).sum(-1) - best
+    return niche + jnp.sqrt(jnp.clip(dist2, 0.0, None))
+
+
+def assoc_p2_maxval(_):
+    denom = nadir - ideal
+    n = (f - ideal[:, None, :]) / denom[:, None, :]
+    d = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
+    proj = jnp.einsum("smk,srk->smr", n, d)
+    p2 = proj * proj
+    niche = jnp.argmax(p2, axis=2)
+    best = p2.max(axis=2)
+    dist2 = (n * n).sum(-1) - best
+    return niche + jnp.sqrt(jnp.clip(dist2, 0.0, None))
+
+
+try:
+    timed("rng vmapped        ", scan(rng_vmapped), jax.random.PRNGKey(0))
+except Exception:
+    pass
+timed("rng vmapped        ", scan(rng_vmapped2), jax.random.PRNGKey(0))
+timed("rng global 2-key   ", scan(rng_global), jax.random.PRNGKey(0))
+timed("rng global 1-key   ", scan(rng_global_one), jax.random.PRNGKey(0))
+timed("assoc current      ", scan(assoc_current), jax.random.PRNGKey(0))
+timed("assoc argmax-p2    ", scan(assoc_p2), jax.random.PRNGKey(0))
+timed("assoc p2 max+argmax", scan(assoc_p2_maxval), jax.random.PRNGKey(0))

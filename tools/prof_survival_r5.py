@@ -1,0 +1,120 @@
+"""Sub-stage profile of survive_batch at bench shape (on-chip, min-of-2).
+
+Stages (cumulative, all inside one lax.scan per measurement):
+  P1  _survive_pre (ranks + normalisation + dirs)         [includes nds]
+  P2  P1 + association
+  P3  P1 + P2 + _survive_post (niching fill)              [= full survival]
+Plus isolated pieces: nds-only, gumbel/rng-only, post-only (fixed inputs).
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "./.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+N_STATES = int(os.environ.get("P_STATES", 1000))
+N_GEN = int(os.environ.get("P_GENS", 60))
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import (
+    NormState,
+    _survive_post,
+    _survive_pre,
+    associate_batch,
+)
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+LCLD = "/root/reference/data/lcld"
+cons = LcldConstraints(f"{LCLD}/features.csv", f"{LCLD}/constraints.csv")
+x = synth_lcld(N_STATES, cons.schema, seed=42)
+sur = load_classifier("/root/reference/models/lcld/nn.model")
+scaler = load_joblib_scaler("/root/reference/models/lcld/scaler.joblib")
+moeva = Moeva2(classifier=sur, constraints=cons, ml_scaler=scaler,
+               norm=2, n_gen=N_GEN, n_pop=100, n_offsprings=100, seed=42)
+
+s = N_STATES
+pop_size = moeva.pop_size
+m = pop_size + moeva.n_offsprings
+asp = moeva.asp_points
+rng = np.random.default_rng(0)
+f0 = jnp.asarray(rng.random((s, m, 3)), jnp.float32)
+key0 = jax.random.PRNGKey(0)
+st0 = jax.vmap(lambda _: NormState.init(3, jnp.float32))(jnp.arange(s))
+
+
+def timed(name, fn, *args):
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(2):
+        t0 = time.time()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.time() - t0)
+    print(f"{name}: {min(ts)/N_GEN*1e3:.2f} ms/gen", flush=True)
+
+
+def scan(body):
+    @jax.jit
+    def run(f, key, st):
+        def step(carry, _):
+            ff, k, sst = carry
+            k, ks = jax.random.split(k)
+            out, sst = body(ks, ff, sst)
+            return (ff + 0.0 * out, k, sst), ()
+        return jax.lax.scan(step, (f, key, st), None, length=N_GEN)[0][0]
+    return run
+
+
+def pre_body(ks, ff, sst):
+    ranks, dirs, nadir, new = jax.vmap(
+        lambda f1, st1: _survive_pre(f1, asp, st1, pop_size)
+    )(ff, sst)
+    return ranks.sum() + dirs.sum() + nadir.sum(), new
+
+
+def assoc_body(ks, ff, sst):
+    ranks, dirs, nadir, new = jax.vmap(
+        lambda f1, st1: _survive_pre(f1, asp, st1, pop_size)
+    )(ff, sst)
+    niche, dist = associate_batch(ff, dirs, new.ideal, nadir)
+    return ranks.sum() + niche.sum() + dist.sum(), new
+
+
+def full_body(ks, ff, sst):
+    from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import survive_batch
+
+    mask, new, ranks = survive_batch(ks, ff, asp, sst, pop_size)
+    return mask.sum(), new
+
+
+def rng_body(ks, ff, sst):
+    keys = jax.random.split(ks, s)
+    g1 = jax.vmap(lambda k: jax.random.gumbel(k, (103,)))(keys)
+    g2 = jax.vmap(lambda k: jax.random.gumbel(k, (m,)))(keys)
+    return g1.sum() + g2.sum(), sst
+
+
+def post_body(ks, ff, sst):
+    # fixed niche/dist/ranks: isolates _survive_post
+    niche = jnp.zeros((s, m), jnp.int32)
+    dist = ff[..., 0]
+    ranks = jnp.asarray(rng.integers(0, 4, (s, m)), jnp.int32)
+    keys = jax.random.split(ks, s)
+    mask = jax.vmap(
+        lambda k, f1, r1, ni, di: _survive_post(k, f1, r1, ni, di, 106, pop_size)
+    )(keys, ff, ranks, niche, dist)
+    return mask.sum(), sst
+
+
+timed("P1 pre (nds+norm+dirs)", scan(pre_body), f0, key0, st0)
+timed("P2 pre+assoc          ", scan(assoc_body), f0, key0, st0)
+timed("P3 full survive_batch ", scan(full_body), f0, key0, st0)
+timed("X  rng/gumbel only    ", scan(rng_body), f0, key0, st0)
+timed("X  post only (fixed)  ", scan(post_body), f0, key0, st0)

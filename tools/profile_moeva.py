@@ -106,7 +106,7 @@ def scan_survive(pop_x, key):
         fpop, k, st = carry
         k, ks = jax.random.split(k)
         mask, st, _ = survive_batch(
-            jax.random.split(ks, s), fpop, asp, st, pop_size,
+            ks, fpop, asp, st, pop_size,
             assoc_block=moeva.assoc_block,
         )
         return (fpop + 0.0 * mask.sum(), k, st), ()

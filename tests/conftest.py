@@ -89,7 +89,7 @@ SLOW_TESTS = {
         "test_autopgd_random_restarts_run",
     },
     "test_moeva_units.py": {
-        "test_survive_batch_matches_vmapped_survive",
+        "test_survive_batch_matches_vmapped_algorithm",
         "test_select_count_and_elitism",
     },
 }

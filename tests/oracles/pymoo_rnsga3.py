@@ -97,7 +97,41 @@ def get_extreme_points_c(F: np.ndarray, ideal_point: np.ndarray, extreme_points=
     return _F[I, :]
 
 
-def get_nadir_point(extreme_points, ideal_point, worst_point, worst_of_front, worst_of_population):
+def solve3_cramer(M: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Adjugate/determinant solve — the jitted kernel's formulation
+    (``survival._solve3``), exposed so the diff test can PIN both sides to
+    one solver inside the ill-conditioned band where LAPACK and Cramer
+    legitimately diverge at tolerance boundaries. Diff-test device, not
+    upstream pymoo semantics."""
+    det = (
+        M[0, 0] * (M[1, 1] * M[2, 2] - M[1, 2] * M[2, 1])
+        - M[0, 1] * (M[1, 0] * M[2, 2] - M[1, 2] * M[2, 0])
+        + M[0, 2] * (M[1, 0] * M[2, 1] - M[1, 1] * M[2, 0])
+    )
+    adj = np.array(
+        [
+            [
+                M[1, 1] * M[2, 2] - M[1, 2] * M[2, 1],
+                M[0, 2] * M[2, 1] - M[0, 1] * M[2, 2],
+                M[0, 1] * M[1, 2] - M[0, 2] * M[1, 1],
+            ],
+            [
+                M[1, 2] * M[2, 0] - M[1, 0] * M[2, 2],
+                M[0, 0] * M[2, 2] - M[0, 2] * M[2, 0],
+                M[0, 2] * M[1, 0] - M[0, 0] * M[1, 2],
+            ],
+            [
+                M[1, 0] * M[2, 1] - M[1, 1] * M[2, 0],
+                M[0, 1] * M[2, 0] - M[0, 0] * M[2, 1],
+                M[0, 0] * M[1, 1] - M[0, 1] * M[1, 0],
+            ],
+        ]
+    )
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return (adj @ b) / det
+
+
+def get_nadir_point(extreme_points, ideal_point, worst_point, worst_of_front, worst_of_population, solver="lapack"):
     """Transcription note: upstream relies on ``np.linalg.LinAlgError`` to
     detect a singular extreme-point matrix. When the matrix has *duplicate
     rows* (the same candidate minimises the ASF on two axes — routine in
@@ -115,21 +149,37 @@ def get_nadir_point(extreme_points, ideal_point, worst_point, worst_of_front, wo
     try:
         M = extreme_points - ideal_point
         b = np.ones(extreme_points.shape[1])
-        plane = np.linalg.solve(M, b)
-        if np.linalg.cond(M) > 1e12:
-            raise np.linalg.LinAlgError()
-        intercepts = 1 / plane
-        nadir_point = ideal_point + intercepts
-        if (
-            not np.allclose(np.dot(M, plane), b)
-            or np.any(intercepts <= 1e-6)
-            or np.any(np.isnan(nadir_point))
-        ):
-            raise np.linalg.LinAlgError()
-        # clamp to the running worst point rather than failing (upstream
-        # "NOTE: different to the proposed version in the paper")
-        b_mask = nadir_point > worst_point
-        nadir_point[b_mask] = worst_point[b_mask]
+        if solver == "cramer":
+            # pinned mode: the kernel's exact arithmetic + success chain
+            # (survival._solve3 / _nadir_point) so both sides agree inside
+            # the ill-conditioned band
+            plane = solve3_cramer(M, b)
+            intercepts = 1 / plane
+            nadir_point = np.minimum(ideal_point + intercepts, worst_point)
+            ok = (
+                np.all(np.isfinite(plane))
+                and np.allclose(M @ plane, b, rtol=1e-5, atol=1e-8)
+                and np.all(intercepts > 1e-6)
+                and np.all(np.isfinite(nadir_point))
+            )
+            if not ok:
+                raise np.linalg.LinAlgError()
+        else:
+            plane = np.linalg.solve(M, b)
+            if np.linalg.cond(M) > 1e12:
+                raise np.linalg.LinAlgError()
+            intercepts = 1 / plane
+            nadir_point = ideal_point + intercepts
+            if (
+                not np.allclose(np.dot(M, plane), b)
+                or np.any(intercepts <= 1e-6)
+                or np.any(np.isnan(nadir_point))
+            ):
+                raise np.linalg.LinAlgError()
+            # clamp to the running worst point rather than failing (upstream
+            # "NOTE: different to the proposed version in the paper")
+            b_mask = nadir_point > worst_point
+            nadir_point[b_mask] = worst_point[b_mask]
     except np.linalg.LinAlgError:
         nadir_point = np.array(worst_of_front, dtype=float, copy=True)
 
@@ -216,7 +266,8 @@ def calc_niche_count(n_niches, niche_of_individuals):
     return niche_count
 
 
-def niching(F, n_remaining, niche_count, niche_of_individuals, dist_to_niche, rng):
+def niching(F, n_remaining, niche_count, niche_of_individuals, dist_to_niche, rng,
+            niche_priority=None, member_priority=None):
     """Upstream pick loop, verbatim dynamics; ``rng`` replaces the global
     numpy RNG. ``F``/``niche_of_individuals``/``dist_to_niche`` are the
     last-front subarrays; returns ``(indices_into_them, deterministic)``.
@@ -225,7 +276,22 @@ def niching(F, n_remaining, niche_count, niche_of_individuals, dist_to_niche, rn
     draw could have changed the returned index set — every sweep used its
     whole min-count cohort (no permutation truncation), every non-empty-niche
     pick had a single candidate, and every empty-niche argmin was tie-free.
+
+    ``niche_priority`` (R,) / ``member_priority`` (len(F),): shared-trace
+    mode (diff-test device, not upstream). Uniform-random choices are
+    replaced by priority order — cutoff cohort = highest ``niche_priority``
+    among eligibles, member pick = LOWEST ``member_priority`` among the
+    niche's remaining members (matching the kernel's ascending-gumbel
+    within-niche ranking). A random permutation/truncation and a top-k by
+    iid continuous keys are the same distribution, and sequential
+    without-replacement uniform picks are exactly ascending order of iid
+    keys — so feeding both implementations the SAME fields must reproduce
+    the same survivor set index-for-index, turning the loop's random paths
+    into an exact comparison. The closest-member rule for empty niches is
+    upstream behaviour and stays (first-index argmin; no shuffle in this
+    mode so ties resolve deterministically on both sides).
     """
+    shared_trace = niche_priority is not None
     survivors = []
     mask = np.full(len(F), True)
     deterministic = True
@@ -241,13 +307,18 @@ def niching(F, n_remaining, niche_count, niche_of_individuals, dist_to_niche, rn
         ]
         if len(next_niches) > n_select:
             deterministic = False  # random cutoff cohort
-        next_niches = next_niches[rng.permutation(len(next_niches))[:n_select]]
+        if shared_trace:
+            order = np.argsort(-niche_priority[next_niches], kind="stable")
+            next_niches = next_niches[order[:n_select]]
+        else:
+            next_niches = next_niches[rng.permutation(len(next_niches))[:n_select]]
 
         for next_niche in next_niches:
             next_ind = np.where(
                 np.logical_and(niche_of_individuals == next_niche, mask)
             )[0]
-            rng.shuffle(next_ind)
+            if not shared_trace:
+                rng.shuffle(next_ind)
 
             if niche_count[next_niche] == 0:
                 d = dist_to_niche[next_ind]
@@ -257,7 +328,10 @@ def niching(F, n_remaining, niche_count, niche_of_individuals, dist_to_niche, rn
             else:
                 if len(next_ind) > 1:
                     deterministic = False  # uniform random member pick
-                next_ind = next_ind[0]
+                if shared_trace:
+                    next_ind = next_ind[np.argmin(member_priority[next_ind])]
+                else:
+                    next_ind = next_ind[0]
 
             mask[next_ind] = False
             survivors.append(int(next_ind))
@@ -287,9 +361,13 @@ def aspiration_survive(
     state: OracleNormState,
     rng: np.random.RandomState,
     mu: float = 0.1,
+    nadir_solver: str = "lapack",
+    niche_priority: np.ndarray | None = None,  # (R,) shared-trace mode
+    member_priority: np.ndarray | None = None,  # (len(F),) original indices
 ):
     """One ``AspirationPointSurvival._do`` round. Mutates ``state``. Returns
-    ``(survivor_indices_into_F, debug)``."""
+    ``(survivor_indices_into_F, debug)``. ``nadir_solver``/priorities: see
+    :func:`solve3_cramer` and :func:`niching` — diff-test pinning devices."""
     F = np.asarray(F, dtype=float)
 
     state.ideal_point = np.min(
@@ -317,6 +395,7 @@ def aspiration_survive(
         state.worst_point,
         worst_of_front,
         worst_of_population,
+        solver=nadir_solver,
     )
 
     # restrict to ranked individuals, in front order (upstream re-indexes the
@@ -361,6 +440,12 @@ def aspiration_survive(
             niche_of_individuals[last_front],
             dist_to_niche[last_front],
             rng,
+            niche_priority=niche_priority,
+            member_priority=(
+                None
+                if member_priority is None
+                else np.asarray(member_priority)[I[last_front]]
+            ),
         )
         survivors_local = np.concatenate(
             (until_last_front, last_front[np.array(S, dtype=int)])

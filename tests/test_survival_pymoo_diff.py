@@ -118,11 +118,7 @@ def _rows_multiset(f, idx, tol_digits=10):
     return sorted(tuple(np.round(f[j], tol_digits)) for j in idx)
 
 
-def _oracle_deterministic(f, asp, n_survive, state_proto, seed=1000):
-    """One oracle selection round; report ``(is_deterministic, multiset)``.
-    Determinism comes from the oracle's own instrumentation of the niching
-    loop (exact: True iff no RNG draw could change the index set), not from
-    sampling seeds — sampling misclassifies p≈0.5 coin-flip cases."""
+def _clone_oracle_state(state_proto):
     st = oracle.OracleNormState(N_OBJ)
     st.ideal_point = state_proto.ideal_point.copy()
     st.worst_point = state_proto.worst_point.copy()
@@ -131,8 +127,17 @@ def _oracle_deterministic(f, asp, n_survive, state_proto, seed=1000):
         if state_proto.extreme_points is None
         else state_proto.extreme_points.copy()
     )
+    return st
+
+
+def _oracle_deterministic(f, asp, n_survive, state_proto, seed=1000, solver="lapack"):
+    """One oracle selection round; report ``(is_deterministic, multiset)``.
+    Determinism comes from the oracle's own instrumentation of the niching
+    loop (exact: True iff no RNG draw could change the index set), not from
+    sampling seeds — sampling misclassifies p≈0.5 coin-flip cases."""
     idx, dbg = oracle.aspiration_survive(
-        f, asp, K1, n_survive, st, np.random.RandomState(seed)
+        f, asp, K1, n_survive, _clone_oracle_state(state_proto),
+        np.random.RandomState(seed), nadir_solver=solver,
     )
     return dbg["niching_deterministic"], _rows_multiset(f, idx)
 
@@ -202,22 +207,30 @@ def _run_diff_case(case_seed, kind, m, n_survive, a, n_generations=3):
         # An ill-conditioned (but not deterministically-singular) extreme
         # matrix sits in the band where the oracle's LAPACK solve and the
         # kernel's Cramer solve legitimately disagree at the tolerance
-        # boundary (see the oracle's get_nadir_point note); skip exact
-        # comparison there. Deterministically-singular systems (cond>=1e15,
-        # e.g. duplicate extreme rows) take the same fallback on both sides
-        # and stay fully compared.
+        # boundary (see the oracle's get_nadir_point note). Rather than skip
+        # (the r4 blind band), PIN the oracle to the kernel's Cramer
+        # formulation there and keep comparing everything downstream — the
+        # LAPACK-vs-Cramer residual is solver noise, the geometry pipeline
+        # under one solver is semantics. Deterministically-singular systems
+        # (cond>=1e15, duplicate extreme rows) take the same fallback on
+        # both sides under either solver.
         cond = np.linalg.cond(dbg["extreme"] - dbg["ideal"])
         borderline = 1e9 < cond < 1e15
-        if not borderline:
-            np.testing.assert_allclose(
-                np.asarray(nadir), dbg["nadir"], rtol=1e-7, atol=1e-9,
-                err_msg=f"nadir mismatch (kind={kind} gen={gen}, cond={cond:.2e})",
+        if borderline:
+            idx_o, dbg = oracle.aspiration_survive(
+                f, asp, K1, n_survive, _clone_oracle_state(st_o_before),
+                np.random.RandomState(case_seed + gen),
+                nadir_solver="cramer",
             )
-        if not borderline:
-            np.testing.assert_allclose(
-                np.asarray(dirs), dbg["ref_dirs"], rtol=1e-7, atol=1e-9,
-                err_msg=f"ref dirs mismatch (kind={kind} gen={gen})",
-            )
+        np.testing.assert_allclose(
+            np.asarray(nadir), dbg["nadir"], rtol=1e-7, atol=1e-9,
+            err_msg=f"nadir mismatch (kind={kind} gen={gen}, cond={cond:.2e}, "
+                    f"borderline={borderline})",
+        )
+        np.testing.assert_allclose(
+            np.asarray(dirs), dbg["ref_dirs"], rtol=1e-7, atol=1e-9,
+            err_msg=f"ref dirs mismatch (kind={kind} gen={gen})",
+        )
 
         # ranks agree on every candidate the oracle ranked (the kernel's
         # unranked tail keeps a sentinel; the oracle's keeps len(F))
@@ -229,22 +242,23 @@ def _run_diff_case(case_seed, kind, m, n_survive, a, n_generations=3):
             f"front ranks mismatch (kind={kind} gen={gen})"
         )
 
-        if not borderline:
-            # niche association: oracle reports the ranked subset in front
-            # order; distances are tie-invariant so compare them always
-            ranked_idx = dbg["ranked_idx"]
-            np.testing.assert_allclose(
-                np.asarray(dist)[ranked_idx], dbg["dist"], rtol=1e-6, atol=1e-9,
-                err_msg=f"niche distance mismatch (kind={kind} gen={gen})",
-            )
-            records.append(
-                {
-                    "f": f,
-                    "st_o_before": st_o_before,
-                    "st_j_before": st_j,
-                    "idx_o": idx_o,
-                }
-            )
+        # niche association: oracle reports the ranked subset in front
+        # order; distances are tie-invariant so compare them always
+        ranked_idx = dbg["ranked_idx"]
+        np.testing.assert_allclose(
+            np.asarray(dist)[ranked_idx], dbg["dist"], rtol=1e-6, atol=1e-9,
+            err_msg=f"niche distance mismatch (kind={kind} gen={gen})",
+        )
+        records.append(
+            {
+                "f": f,
+                "st_o_before": st_o_before,
+                "st_j_before": st_j,
+                "idx_o": idx_o,
+                "solver": "cramer" if borderline else "lapack",
+                "n_dirs": np.asarray(dirs).shape[0],
+            }
+        )
         st_j = st_j_new
 
     return asp, records
@@ -261,7 +275,8 @@ def _diff_fuzz(n_cases, seed0):
         for gen, rec in enumerate(records):
             f = rec["f"]
             det, surv_o = _oracle_deterministic(
-                f, asp_j.__array__(), n_survive, rec["st_o_before"]
+                f, asp_j.__array__(), n_survive, rec["st_o_before"],
+                solver=rec["solver"],
             )
             for key_i in range(2):
                 key = jax.random.PRNGKey(seed * 7 + gen * 3 + key_i)
@@ -293,6 +308,61 @@ def test_survival_matches_pymoo_oracle_quick():
 @pytest.mark.slow
 def test_survival_matches_pymoo_oracle_full():
     _diff_fuzz(n_cases=400, seed0=50_000)
+
+
+def _shared_trace_fuzz(n_cases, seed0, min_random):
+    """EXACT survivor-set comparison through the RANDOM niching paths: both
+    implementations consume the same two gumbel fields (the kernel natively;
+    the oracle via priority-injected niching — a random permutation/truncation
+    is distributionally a top-k by iid keys, and sequential uniform
+    without-replacement picks are exactly ascending iid-key order), so the
+    water-filling + vectorised ranking must reproduce pymoo's sequential pick
+    loop index-for-index, not just in distribution."""
+    n_random = n_checked = 0
+    for i, kind, m, n_survive, a, seed in _case_stream(n_cases, seed0):
+        asp, records = _run_diff_case(seed, kind, m, n_survive, a)
+        asp_j = jnp.asarray(asp)
+        for gen, rec in enumerate(records):
+            f = rec["f"]
+            det, _ = _oracle_deterministic(
+                f, asp, n_survive, rec["st_o_before"], solver=rec["solver"]
+            )
+            key = jax.random.PRNGKey(seed * 11 + gen)
+            mask, _, _ = _jax_survive(
+                key, jnp.asarray(f), asp_j, rec["st_j_before"], n_survive
+            )
+            gum_cut, gum_mem = sv._niche_gumbels(
+                key, (), rec["n_dirs"], f.shape[0]
+            )
+            idx_o, _ = oracle.aspiration_survive(
+                f, asp, K1, n_survive, _clone_oracle_state(rec["st_o_before"]),
+                np.random.RandomState(0),
+                nadir_solver=rec["solver"],
+                niche_priority=np.asarray(gum_cut),
+                member_priority=np.asarray(gum_mem),
+            )
+            got = sorted(np.where(np.asarray(mask))[0].tolist())
+            want = sorted(np.asarray(idx_o).tolist())
+            assert got == want, (
+                f"shared-trace survivor mismatch (kind={kind} case={i} "
+                f"gen={gen} det={det}): kernel={got} oracle={want}"
+            )
+            n_checked += 1
+            if not det:
+                n_random += 1
+    # the point of this fuzz is the RANDOM paths — require real coverage
+    assert n_random >= min_random, (
+        f"only {n_random} random-niching cases exercised ({n_checked} total)"
+    )
+
+
+def test_survival_shared_trace_exact_quick():
+    _shared_trace_fuzz(n_cases=40, seed0=130_000, min_random=8)
+
+
+@pytest.mark.slow
+def test_survival_shared_trace_exact_full():
+    _shared_trace_fuzz(n_cases=240, seed0=160_000, min_random=40)
 
 
 @pytest.mark.slow

@@ -18,6 +18,26 @@ from ..utils.config import get_dict_hash
 from ..utils.in_out import load_model
 
 
+def setup_jax_cache(config: dict | None = None) -> None:
+    """Point XLA's persistent compilation cache at a per-repo directory so
+    every runner invocation of the same jitted attack program after the first
+    loads its executable from disk instead of recompiling (~tens of seconds
+    per program shape; an rq grid revisits the same handful of shapes across
+    many processes). ``system.jax_cache_dir: ""`` disables."""
+    import jax
+
+    cache_dir = ".jax_cache"
+    if config is not None:
+        cache_dir = config.get("system", {}).get("jax_cache_dir", cache_dir)
+    if not cache_dir:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # never let cache plumbing break an experiment
+        print(f"persistent compilation cache unavailable: {e}")
+
+
 def metrics_path_for(config: dict, mid_fix: str) -> str:
     out_dir = config["dirs"]["results"]
     return f"{out_dir}/metrics_{mid_fix}_{get_dict_hash(config)}.json"

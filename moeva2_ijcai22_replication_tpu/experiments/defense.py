@@ -114,6 +114,7 @@ def moeva_attack(model, constraints, ml_scaler, config, x_cand) -> np.ndarray:
         norm=config["norm"], n_gen=config["budget"],
         n_pop=config["n_pop"], n_offsprings=config["n_offsprings"],
         seed=config["seed"], mesh=mesh,
+        assoc_block=config.get("assoc_block") or None,
     ).generate(x_run, 1)
     return result.x_ml[:n]
 
@@ -214,6 +215,8 @@ def run(config: dict) -> dict:
     """Execute the defense pipeline; returns the artifact-path map."""
     import joblib
     import pandas as pd
+
+    common.setup_jax_cache(config)
 
     project = config["project_name"]
     knobs = dict(PROJECT_DEFAULTS[project.split("_")[0]])

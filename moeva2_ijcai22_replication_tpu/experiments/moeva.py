@@ -28,6 +28,7 @@ from . import common
 def run(config: dict):
     """Execute one MoEvA2 experiment; returns the metrics dict, or None when
     the config hash already has results (skip-if-done)."""
+    common.setup_jax_cache(config)
     out_dir = config["dirs"]["results"]
     config_hash = get_dict_hash(config)
     mid_fix = f"{config['attack_name']}"
@@ -63,6 +64,9 @@ def run(config: dict):
         init_eps=config.get("init_eps", 0.1),
         init_ratio=config.get("init_ratio", 0.5),
         archive_size=config.get("archive_size", 0),
+        # association formulation (None = one-shot einsum; an int = blocked
+        # scan with that direction-block size, bit-identical results)
+        assoc_block=config.get("assoc_block") or None,
         save_history=config.get("save_history") or None,
         # crash recovery: a rerun of this config hash resumes mid-attack
         # from the last ``checkpoint_every``-generation boundary instead of

@@ -29,6 +29,7 @@ from . import common
 def run(config: dict):
     """Execute one gradient-attack experiment; returns the metrics dict, or
     None when the config hash already has results."""
+    common.setup_jax_cache(config)
     out_dir = config["dirs"]["results"]
     config_hash = get_dict_hash(config)
     mid_fix = f"{config['attack_name']}_{config['loss_evaluation']}"

@@ -47,6 +47,7 @@ def _require(path: str) -> str:
 def run(config: dict) -> dict:
     import joblib
 
+    common.setup_jax_cache(config)
     project = config["project_name"]
     knobs = dict(PROJECT_DEFAULTS[project.split("_")[0]])
     knobs.update(config.get("defense", {}))

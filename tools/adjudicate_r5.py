@@ -1,0 +1,89 @@
+"""Round-5 adjudication: does the corrected survival kernel converge at the
+reference budget (n_gen=1000) on the real botnet candidate set?
+
+Context: the round-4 survival fix (1141e71 — aspiration points folded into
+ideal/worst and extreme candidates, nadir clamped to running worst; all
+validated against the vendored pymoo 0.4.2.2 oracle) dropped budget-100
+o-rates 4.5x (o2 0.899 -> 0.199).  The pre-fix kernel deviated from the
+algorithm the reference actually runs (pymoo AspirationPointSurvival, via
+``/root/reference/src/attacks/moeva2/moeva2.py:113-124``), so its numbers
+measured a *different* attack.  This script measures the corrected attack at
+several budgets to show the trajectory, separating final-population rates
+from archive rates.
+
+Writes out/adjudication_r5.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGETS = [int(g) for g in os.environ.get("ADJ_GENS", "100,300,1000").split(",")]
+ARCHIVE = int(os.environ.get("ADJ_ARCHIVE", 24))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "./.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+    from moeva2_ijcai22_replication_tpu.attacks.objective import ObjectiveCalculator
+    from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+    from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+    from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+    base = "/root/reference"
+    cons = BotnetConstraints(
+        f"{base}/data/botnet/features.csv", f"{base}/data/botnet/constraints.csv"
+    )
+    x = np.load(f"{base}/data/botnet/x_candidates_common.npy")
+    sur = load_classifier(f"{base}/models/botnet/nn.model")
+    scaler = load_joblib_scaler(f"{base}/models/botnet/scaler.joblib")
+    calc = ObjectiveCalculator(
+        classifier=sur, constraints=cons,
+        thresholds={"f1": 0.5, "f2": 4.0},
+        min_max_scaler=scaler, ml_scaler=scaler,
+        minimize_class=1, norm=2,
+    )
+
+    out = {"n_states": int(x.shape[0]), "archive_size": ARCHIVE, "budgets": {}}
+    for n_gen in BUDGETS:
+        moeva = Moeva2(
+            classifier=sur, constraints=cons, ml_scaler=scaler,
+            norm=2, n_gen=n_gen, n_pop=200, n_offsprings=100, seed=42,
+            archive_size=ARCHIVE,
+        )
+        t0 = time.time()
+        res = moeva.generate(x, minimize_class=1)
+        wall = time.time() - t0
+        pop = res.x_ml[:, : moeva.pop_size]
+        rates_pop = [round(float(r), 4) for r in calc.success_rate_3d(x, pop)]
+        rates_all = [round(float(r), 4) for r in calc.success_rate_3d(x, res.x_ml)]
+        out["budgets"][str(n_gen)] = {
+            "wall_s": round(wall, 1),
+            "o_rates_final_pop": rates_pop,
+            "o_rates_with_archive": rates_all,
+        }
+        print(
+            f"[adj] n_gen={n_gen}: {wall:.1f}s  pop o1..o7: "
+            + " ".join(f"{r:.3f}" for r in rates_pop)
+            + "  | +archive: "
+            + " ".join(f"{r:.3f}" for r in rates_all),
+            flush=True,
+        )
+
+    os.makedirs("out", exist_ok=True)
+    with open("out/adjudication_r5.json", "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Find a small CPU botnet attack whose o-rates are strictly interior.
+
+VERDICT r4: the old parity_botnet_cpu_small fixture had fully saturated 0/1
+rates, so it passed unchanged through a behaviour-altering survival fix. A
+useful determinism fixture needs success rates strictly inside (0, 1) on the
+discriminating columns (o2/o4) so any semantic change moves them.
+
+Runs candidate configs under the EXACT test environment (CPU x64, virtual
+8-device platform — tests/conftest.py) and reports their rates; writes the
+chosen fixture when a config has 0 < o2 < 1 and 0 < o4 < 1.
+"""
+
+import itertools
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.objective import ObjectiveCalculator
+from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+REF = "/root/reference"
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "fixtures"
+)
+
+cons = BotnetConstraints(
+    f"{REF}/data/botnet/features.csv", f"{REF}/data/botnet/constraints.csv"
+)
+x_all = np.load(f"{REF}/data/botnet/x_candidates_common.npy")
+sur = load_classifier(f"{REF}/models/botnet/nn.model")
+scaler = load_joblib_scaler(f"{REF}/models/botnet/scaler.joblib")
+calc = ObjectiveCalculator(
+    classifier=sur, constraints=cons, thresholds={"f1": 0.5, "f2": 4.0},
+    min_max_scaler=scaler, ml_scaler=scaler, minimize_class=1, norm=2,
+)
+
+best = None
+for n_states, n_gen, archive in itertools.product(
+    (32, 48), (60, 90, 120), (8,)
+):
+    x = x_all[:n_states]
+    moeva = Moeva2(
+        classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
+        n_gen=n_gen, n_pop=40, n_offsprings=20, seed=42,
+        archive_size=archive,
+    )
+    res = moeva.generate(x, minimize_class=1)
+    rates = [round(float(r), 6) for r in calc.success_rate_3d(x, res.x_ml)]
+    interior = all(0.0 < rates[i] < 1.0 for i in (1, 3))
+    print(f"[search] S={n_states} gens={n_gen} arch={archive}: {rates}"
+          f"{'  <-- interior' if interior else ''}", flush=True)
+    if interior and best is None:
+        best = {
+            "n_states": n_states, "n_gen": n_gen, "n_pop": 40,
+            "n_offsprings": 20, "archive_size": archive, "seed": 42,
+            "thresholds": {"f1": 0.5, "f2": 4.0}, "norm": 2,
+            "o_rates": rates,
+            "note": (
+                "rates strictly interior on o2/o4 BY CONSTRUCTION so any "
+                "survival/operator semantic change moves them (the old "
+                "all-saturated fixture passed through a behaviour-altering "
+                "fix unchanged); regenerated round 5 with the corrected "
+                "survival kernel on the CPU x64 test platform"
+            ),
+        }
+
+if best:
+    with open(f"{FIXTURES}/parity_botnet_cpu_small.json", "w") as fh:
+        json.dump(best, fh, indent=1)
+    print(f"[search] fixture written: {best}", flush=True)
+else:
+    print("[search] NO interior config found", flush=True)

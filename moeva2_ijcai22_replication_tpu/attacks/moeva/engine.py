@@ -111,6 +111,16 @@ class Moeva2:
     #: one. 0 / None = off. Completed runs remove the checkpoint.
     checkpoint_every: int = 0
     checkpoint_path: str | None = None
+    #: process the states axis in sequential chunks of at most this many
+    #: states through ONE compiled program (the tail chunk is padded with
+    #: copies of the last state and trimmed afterwards). States are
+    #: embarrassingly parallel — a chunked run is a concatenation of
+    #: independent attacks with per-chunk folded keys — so this changes
+    #: random draws but not semantics (the reference runs every state as its
+    #: own process). Bounds device memory at large state counts and
+    #: sidesteps the worker-fault program-size band documented in
+    #: docs/DESIGN.md §3. None = one batch.
+    max_states_per_call: int | None = None
     dtype: Any = jnp.float32
     mesh: jax.sharding.Mesh | None = None
     states_axis: str = "states"
@@ -354,6 +364,77 @@ class Moeva2:
         if minimize_class.shape[0] != s:
             raise ValueError("minimize_class must be scalar or length n_states")
 
+        chunk = self.max_states_per_call
+        if chunk and s > chunk:
+            if self.mesh is not None and chunk % self.mesh.size:
+                raise ValueError(
+                    f"max_states_per_call={chunk} must be a multiple of the "
+                    f"mesh size {self.mesh.size}"
+                )
+            return self._generate_chunked(x, minimize_class, chunk)
+        return self._generate_one(
+            x, minimize_class,
+            jax.random.PRNGKey(self.seed), self.checkpoint_path,
+        )
+
+    def _generate_chunked(self, x, minimize_class, chunk) -> MoevaResult:
+        """Sequential chunks of one compiled program; the tail chunk is
+        padded (states are independent, the pad rows are trimmed) so every
+        dispatch reuses the same executable. Chunk keys are folds of the
+        seed key, so chunks draw independent random streams."""
+        t0 = time.time()
+        s = x.shape[0]
+        base_key = jax.random.PRNGKey(self.seed)
+        parts: list[MoevaResult] = []
+        for i, start in enumerate(range(0, s, chunk)):
+            xc = x[start : start + chunk]
+            mc = minimize_class[start : start + chunk]
+            n_real = xc.shape[0]
+            if n_real < chunk:  # pad the tail with the last state
+                pad = chunk - n_real
+                xc = np.concatenate([xc, np.repeat(xc[-1:], pad, axis=0)])
+                mc = np.concatenate([mc, np.repeat(mc[-1:], pad, axis=0)])
+            cp_path = (
+                f"{self.checkpoint_path}.chunk{i}" if self.checkpoint_path else None
+            )
+            res = self._generate_one(
+                xc, mc, jax.random.fold_in(base_key, i), cp_path
+            )
+            parts.append(
+                MoevaResult(
+                    x_gen=res.x_gen[:n_real],
+                    f=res.f[:n_real],
+                    x_ml=res.x_ml[:n_real],
+                    x_initial=res.x_initial[:n_real],
+                    n_gen=res.n_gen,
+                    time=res.time,
+                    history=None
+                    if res.history is None
+                    else [h[:n_real] for h in res.history],
+                )
+            )
+        history = None
+        if parts[0].history is not None:
+            history = [
+                np.concatenate(hs, axis=0) for hs in zip(*(p.history for p in parts))
+            ]
+        return MoevaResult(
+            x_gen=np.concatenate([p.x_gen for p in parts], axis=0),
+            f=np.concatenate([p.f for p in parts], axis=0),
+            x_ml=np.concatenate([p.x_ml for p in parts], axis=0),
+            x_initial=x,
+            n_gen=self.n_gen,
+            time=time.time() - t0,
+            history=history,
+        )
+
+    def _generate_one(
+        self,
+        x: np.ndarray,
+        minimize_class: np.ndarray,
+        key: jax.Array,
+        checkpoint_path: str | None,
+    ) -> MoevaResult:
         xl_ml, xu_ml = self.constraints.get_feature_min_max(dynamic_input=x)
         xl_ml = np.broadcast_to(np.asarray(xl_ml, dtype=np.float64), x.shape)
         xu_ml = np.broadcast_to(np.asarray(xu_ml, dtype=np.float64), x.shape)
@@ -370,18 +451,18 @@ class Moeva2:
             jnp.asarray(minimize_class, jnp.int32),
             jnp.asarray(xl_ml, self.dtype),
             jnp.asarray(xu_ml, self.dtype),
-            jax.random.PRNGKey(self.seed),
+            key,
         )
         if self.mesh is not None:
             args = self._shard_args(args)
         params, x_dev, mc_dev, xl_dev, xu_dev, key = args
 
         cp = None
-        if self.checkpoint_every and self.checkpoint_path:
+        if self.checkpoint_every and checkpoint_path:
             from .checkpoint import AttackCheckpointer
 
             cp = AttackCheckpointer(
-                self.checkpoint_path,
+                checkpoint_path,
                 self._fingerprint(x, minimize_class, xl_ml, xu_ml),
             )
 

@@ -115,6 +115,7 @@ def moeva_attack(model, constraints, ml_scaler, config, x_cand) -> np.ndarray:
         n_pop=config["n_pop"], n_offsprings=config["n_offsprings"],
         seed=config["seed"], mesh=mesh,
         assoc_block=config.get("assoc_block") or None,
+        max_states_per_call=config.get("max_states_per_call") or None,
     ).generate(x_run, 1)
     return result.x_ml[:n]
 

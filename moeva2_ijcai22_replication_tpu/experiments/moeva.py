@@ -67,6 +67,7 @@ def run(config: dict):
         # association formulation (None = one-shot einsum; an int = blocked
         # scan with that direction-block size, bit-identical results)
         assoc_block=config.get("assoc_block") or None,
+        max_states_per_call=config.get("max_states_per_call") or None,
         save_history=config.get("save_history") or None,
         # crash recovery: a rerun of this config hash resumes mid-attack
         # from the last ``checkpoint_every``-generation boundary instead of

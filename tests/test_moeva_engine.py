@@ -351,6 +351,49 @@ class TestHistoryChunking:
         np.testing.assert_allclose(small.f, big.f)
 
 
+class TestChunkedStates:
+    def test_chunked_run_matches_unchunked_per_chunk(self, lcld_constraints, surrogate):
+        """max_states_per_call splits the states axis into sequential
+        dispatches of one compiled program. States are independent, so the
+        first chunk of a chunked run must equal an unchunked run on exactly
+        those states with the chunk's folded key — and the padded tail chunk
+        must be trimmed back to the real states."""
+        import jax
+
+        x = synth_lcld(10, lcld_constraints.schema, seed=13)
+        kw = dict(
+            classifier=surrogate, constraints=lcld_constraints,
+            ml_scaler=_scaler_for(x), norm=2, n_gen=5, n_pop=20,
+            n_offsprings=10, seed=11, dtype=jnp.float64,
+        )
+        chunked = Moeva2(**kw, max_states_per_call=4).generate(x, 1)
+        assert chunked.x_ml.shape[0] == 10  # tail pad (4+4+2) trimmed
+        np.testing.assert_array_equal(chunked.x_initial, x)
+        assert np.isfinite(chunked.f).all()
+
+        # chunk 0 equals a standalone 4-state attack run with fold_in(key, 0)
+        solo = Moeva2(**kw)
+        res0 = solo._generate_one(
+            x[:4], np.full(4, 1), jax.random.fold_in(jax.random.PRNGKey(11), 0),
+            None,
+        )
+        np.testing.assert_allclose(chunked.x_ml[:4], res0.x_ml)
+        np.testing.assert_allclose(chunked.f[:4], res0.f)
+
+    def test_chunked_history_concatenates(self, lcld_constraints, surrogate):
+        x = synth_lcld(5, lcld_constraints.schema, seed=14)
+        moeva = Moeva2(
+            classifier=surrogate, constraints=lcld_constraints,
+            ml_scaler=_scaler_for(x), norm=2, n_gen=4, n_pop=12,
+            n_offsprings=6, seed=2, dtype=jnp.float64,
+            max_states_per_call=2, save_history="reduced",
+        )
+        res = moeva.generate(x, 1)
+        assert len(res.history) == 4  # init + (n_gen-1) per-generation records
+        assert res.history[0].shape[0] == 5  # states axis re-assembled
+        assert all(h.shape[0] == 5 for h in res.history[1:])
+
+
 class TestEliteArchive:
     def test_archive_appends_columns_and_is_monotone(
         self, lcld_constraints, surrogate

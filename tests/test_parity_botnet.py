@@ -9,18 +9,22 @@ tables (metric definition: ``objective_calculator.py:86-119``) are committed
 as fixtures that CI re-derives:
 
 - ``parity_botnet_rq1.json`` — the full-scale run record (387 states ×
-  1000 generations, pop 200, seed 42, single TPU chip, 76.8 s) plus a pinned
-  8-state/24-candidate slice of its attack output
-  (``parity_botnet_{x,adv}.npy``) whose o-rates CI recomputes bit-for-bit.
-- ``parity_botnet_cpu_small.json`` — a small attack (16 states × 40 gens)
+  1000 generations, pop 200, seed 42, single TPU chip) plus a pinned
+  8-state slice of its attack output (``parity_botnet_{x,adv}.npy``) whose
+  o-rates CI recomputes bit-for-bit.
+- ``parity_botnet_cpu_small.json`` — a small attack (48 states × 80 gens)
   re-RUN from scratch in CI on the deterministic CPU backend and checked
-  against its pinned rates.
+  against its pinned rates. Its o2/o4 rates are strictly interior in (0, 1)
+  BY CONSTRUCTION: the previous 16×40 fixture had fully saturated 0/1 rates
+  and passed unchanged through a behaviour-altering survival fix.
 
-Full-scale numbers for the record (budget 1000): MoEvA o1..o7 =
-[1, 1, 1, .0749, 1, 1, .0749] without an archive and .969 with the
-production ``archive_size: 24`` default; PGD(flip) flips every state but
-satisfies constraints nowhere (o2=1, o1=o7=0); PGD(constraints+flip) stops
-flipping (o2=0); PGD(flip)+SAT repairs every flip exactly (o7=1.0) — the
+Full-scale numbers for the record, REGENERATED round 5 with the corrected
+(pymoo-oracle-validated) survival kernel (budget 1000): MoEvA o1..o7 all
+1.0 — final population alone AND with the archive (the pre-fix kernel's
+converged population lost mid-run constrained adversarials, o4 = 0.0749;
+its values are preserved in the fixture under ``pre_fix_r3``); PGD(flip)+
+SAT repairs every flip exactly (o7 = 1.0); the rq2 augmented defense and
+rq3 retrained model block every flip at budget 100 (o2 = 0) — the
 reference paper's qualitative botnet story end to end. All success rates
 are f64 judgements (``ObjectiveCalculator(precise=True)``): botnet sum
 equalities run at magnitudes (~6e9) beyond f32 ulp resolution.
@@ -91,11 +95,16 @@ class TestSmallAttackReproduces:
         backend — the CI platform the fixture was generated on)."""
         cons, sur, scaler = real_botnet
         rec = json.load(open(f"{FIXTURES}/parity_botnet_cpu_small.json"))
+        # the fixture must stay SENSITIVE: strictly interior o2/o4 pins so a
+        # semantic change to survival/operators moves them (saturated 0/1
+        # pins once let a behaviour-altering fix through unnoticed)
+        assert 0.0 < rec["o_rates"][1] < 1.0 and 0.0 < rec["o_rates"][3] < 1.0
         x = botnet_candidates[: rec["n_states"]]
         moeva = Moeva2(
             classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
             n_gen=rec["n_gen"], n_pop=rec["n_pop"],
             n_offsprings=rec["n_offsprings"], seed=rec["seed"],
+            archive_size=rec.get("archive_size", 0),
         )
         res = moeva.generate(x, minimize_class=1)
         calc = make_calc(cons, sur, scaler, rec["thresholds"])

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-5 evidence runs with the CORRECTED survival kernel: the full rq
+# grid family for both use cases, rq0 smokes, rq4 attacks, and the sm1.1
+# sweep — committed metrics for out/attacks/ (round-4 never executed its
+# version of this script; the stale pre-fix LCLD outputs were deleted).
+# Idempotent: every runner skips config hashes that already have metrics.
+set -u
+export PYTHONPATH=/root/repo:/root/.axon_site
+cd /root/repo
+PKG=moeva2_ijcai22_replication_tpu.experiments
+
+step() { echo "=== [$(date +%H:%M:%S)] $* ==="; }
+
+step rq1.lcld
+timeout 7200 python -m $PKG.rq -c config/rq1.lcld.yaml
+step rq2.lcld
+timeout 7200 python -m $PKG.rq -c config/rq2.lcld.yaml
+step rq3.lcld
+timeout 7200 python -m $PKG.rq -c config/rq3.lcld.yaml
+step rq1.botnet
+timeout 14400 python -m $PKG.rq -c config/rq1.botnet.yaml
+step rq2.botnet
+timeout 7200 python -m $PKG.rq -c config/rq2.botnet.yaml
+step rq3.botnet
+timeout 7200 python -m $PKG.rq -c config/rq3.botnet.yaml
+step rq0.botnet
+timeout 3600 python -m $PKG.pgd -c config/rq0.botnet.yaml
+step rq0.lcld
+timeout 3600 python -m $PKG.pgd -c config/rq0.lcld.yaml
+step rq4.moeva
+timeout 7200 python -m $PKG.moeva -c config/moeva.yaml -c config/rq4.lcld.moeva.yaml
+step rq4.moeva_augmented
+timeout 7200 python -m $PKG.moeva -c config/moeva.yaml -c config/rq4.lcld.moeva_augmented.yaml
+step sm1.1.lcld
+timeout 10800 python -m $PKG.rq -c config/sm1.1.lcld.yaml
+echo "=== all grids done ==="

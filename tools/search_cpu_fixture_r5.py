@@ -54,25 +54,28 @@ calc = ObjectiveCalculator(
     min_max_scaler=scaler, ml_scaler=scaler, minimize_class=1, norm=2,
 )
 
+N_POP = int(os.environ.get("SEARCH_POP", 100))
+N_OFF = int(os.environ.get("SEARCH_OFF", 50))
+STATES = [int(s) for s in os.environ.get("SEARCH_STATES", "48,64").split(",")]
+GENS = [int(g) for g in os.environ.get("SEARCH_GENS", "40,60,80").split(",")]
+
 best = None
-for n_states, n_gen, archive in itertools.product(
-    (32, 48), (60, 90, 120), (8,)
-):
+for n_states, n_gen, archive in itertools.product(STATES, GENS, (8,)):
     x = x_all[:n_states]
     moeva = Moeva2(
         classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
-        n_gen=n_gen, n_pop=40, n_offsprings=20, seed=42,
+        n_gen=n_gen, n_pop=N_POP, n_offsprings=N_OFF, seed=42,
         archive_size=archive,
     )
     res = moeva.generate(x, minimize_class=1)
-    rates = [round(float(r), 6) for r in calc.success_rate_3d(x, res.x_ml)]
+    rates = [float(r) for r in calc.success_rate_3d(x, res.x_ml)]
     interior = all(0.0 < rates[i] < 1.0 for i in (1, 3))
     print(f"[search] S={n_states} gens={n_gen} arch={archive}: {rates}"
           f"{'  <-- interior' if interior else ''}", flush=True)
     if interior and best is None:
         best = {
-            "n_states": n_states, "n_gen": n_gen, "n_pop": 40,
-            "n_offsprings": 20, "archive_size": archive, "seed": 42,
+            "n_states": n_states, "n_gen": n_gen, "n_pop": N_POP,
+            "n_offsprings": N_OFF, "archive_size": archive, "seed": 42,
             "thresholds": {"f1": 0.5, "f2": 4.0}, "norm": 2,
             "o_rates": rates,
             "note": (

@@ -397,8 +397,26 @@ def run(config: dict) -> dict:
     # (botnet/01_train_robust.py:275).
     if knobs["gradient_model"]:
         both = adv_moeva_index & adv_gradient_index
-        moeva_mask = both[adv_moeva_index]
-        gradient_mask = both[adv_gradient_index]
+        if not both.any():
+            # On the bootstrapped family the gradient attack can come back
+            # EMPTY (the paper's own finding: constrained PGD rarely beats
+            # LCLD validity) — the strict LCLD intersection then retrains on
+            # zero adversarials and silently ships base weights as the
+            # "defended" models (observed round 5: nn_moeva was md5-equal to
+            # nn.msgpack). Fall back to the botnet reference's semantics
+            # (retrain on every MoEvA success, botnet/01_train_robust.py:275)
+            # so nn_moeva is a real defense artifact; nn_gradient still
+            # honestly degenerates to base when there are no gradient
+            # adversarials at all.
+            print(
+                "WARNING: both-attacks intersection is empty; retraining "
+                "nn_moeva on all MoEvA successes (botnet semantics)"
+            )
+            moeva_mask = np.ones(len(x_adv_moeva), dtype=bool)
+            gradient_mask = np.ones(len(x_adv_gradient), dtype=bool)
+        else:
+            moeva_mask = both[adv_moeva_index]
+            gradient_mask = both[adv_gradient_index]
     else:
         moeva_mask = np.ones(len(x_adv_moeva), dtype=bool)
         gradient_mask = np.ones(len(x_adv_gradient), dtype=bool)

@@ -264,3 +264,34 @@ def test_resume_crosses_mesh_boundaries(problem, tmp_path):
     )
     np.testing.assert_array_equal(resumed_m.x_gen, resumed_1.x_gen)
     np.testing.assert_allclose(resumed_m.f, resumed_1.f, rtol=0, atol=1e-12)
+
+
+def test_chunked_run_resumes_bit_identical(problem, tmp_path):
+    """Chunked execution (max_states_per_call) gives every chunk its own
+    checkpoint file (``.chunk{i}`` suffix); a crash inside a later chunk must
+    resume THAT chunk mid-run — earlier chunks' work is already durable and
+    the final result equals an uninterrupted chunked run bit for bit."""
+    _, _, x, _ = problem  # 4 states -> chunks of 2
+
+    reference = _engine(problem, None, max_states_per_call=2).generate(x)
+
+    cp_path = str(tmp_path / "cp_chunked.npz")
+    crashed = _engine(
+        problem, None, max_states_per_call=2,
+        checkpoint_every=3, checkpoint_path=cp_path,
+    )
+    # chunk 0 takes dispatches 1-3 (9 generations in segments of <=3);
+    # dispatch 5 lands inside chunk 1, past its first checkpoint boundary
+    _crash_on_call(crashed, 5)
+    with pytest.raises(_InjectedCrash):
+        crashed.generate(x)
+    assert os.path.exists(cp_path + ".chunk1"), "chunk 1 must have checkpointed"
+    assert not os.path.exists(cp_path + ".chunk0"), "finished chunk cleared"
+
+    resumed = _engine(
+        problem, None, max_states_per_call=2,
+        checkpoint_every=3, checkpoint_path=cp_path,
+    ).generate(x)
+    np.testing.assert_array_equal(resumed.x_gen, reference.x_gen)
+    np.testing.assert_array_equal(resumed.f, reference.f)
+    assert not os.path.exists(cp_path + ".chunk1"), "completed run cleans up"

@@ -139,18 +139,26 @@ def measure_grid_wallclock() -> dict | None:
             for name in ("config", "models", "data", ".jax_cache"):
                 os.symlink(os.path.join(repo, name), os.path.join(td, name))
             t0 = time.time()
-            r = subprocess.run(
-                [
-                    sys.executable, "-m",
-                    "moeva2_ijcai22_replication_tpu.experiments.rq",
-                    "-c", "config/rq1.lcld.yaml",
-                ],
-                cwd=td, capture_output=True, text=True,
-                env=dict(
-                    os.environ,
-                    PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
-                ),
-            )
+            try:
+                r = subprocess.run(
+                    [
+                        sys.executable, "-m",
+                        "moeva2_ijcai22_replication_tpu.experiments.rq",
+                        "-c", "config/rq1.lcld.yaml",
+                    ],
+                    cwd=td, capture_output=True, text=True,
+                    # a hung tunnel in the grid must not take the whole
+                    # bench record down with it
+                    timeout=int(os.environ.get("BENCH_GRID_TIMEOUT", 1200)),
+                    env=dict(
+                        os.environ,
+                        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                    ),
+                )
+            except subprocess.TimeoutExpired:
+                log(f"[bench] grid {label}: timed out; skipping grid metric")
+                out[label + "_rc"] = "timeout"
+                continue
             dt = time.time() - t0
             n_metrics = sum(
                 f.startswith("metrics_")

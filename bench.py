@@ -160,13 +160,34 @@ def measure_grid_wallclock() -> dict | None:
                 out[label + "_rc"] = "timeout"
                 continue
             dt = time.time() - t0
-            n_metrics = sum(
-                f.startswith("metrics_")
-                for _, _, fs in os.walk(os.path.join(td, "out"))
-                for f in fs
-            )
+            n_metrics = 0
+            report = None
+            for root, _, fs in os.walk(os.path.join(td, "out")):
+                for f in fs:
+                    n_metrics += f.startswith("metrics_")
+                    if f.startswith("grid_report_"):
+                        try:
+                            with open(os.path.join(root, f)) as fh:
+                                report = json.load(fh)
+                        except Exception:
+                            pass
             out[label + "_s"] = round(dt, 1)
             out[label + "_runs"] = n_metrics
+            if report:
+                # pipeline observability: attribute grid-wallclock movement
+                # to executable/artifact reuse vs raw compute across rounds
+                out[label + "_pipeline"] = {
+                    k: report.get(k)
+                    for k in (
+                        "distinct_compiled_programs",
+                        "attack_compile_s",
+                        "attack_run_s",
+                        "evaluate_s",
+                        "write_s",
+                        "artifact_cache",
+                        "engine_cache",
+                    )
+                }
             log(
                 f"[bench] grid {label}: {dt:.1f}s, {n_metrics} metrics files, "
                 f"rc={r.returncode}"

@@ -154,6 +154,9 @@ class Moeva2:
             )
         self._jit_init = None
         self._jit_segment = None
+        #: number of program (re)traces across init + segment — one per
+        #: distinct executable (grid observability reads the delta per point).
+        self.trace_count = 0
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
@@ -205,6 +208,7 @@ class Moeva2:
 
         def init(params, x_init_ml, minimize_class, xl_ml, xu_ml, key):
             eng = self  # close over static config
+            eng.trace_count += 1  # body runs once per (re)trace
             s = x_init_ml.shape[0]
             xl_gen, xu_gen = codec_lib.genetic_bounds(codec, xl_ml, xu_ml)
             x_init_mm = codec_lib.minmax_normalize(x_init_ml, xl_ml, xu_ml)
@@ -278,6 +282,7 @@ class Moeva2:
 
         def segment(params, x_init_ml, minimize_class, xl_ml, xu_ml, carry, length):
             eng = self
+            eng.trace_count += 1  # one per (re)trace: distinct length retraces
             s = x_init_ml.shape[0]
             xl_gen, xu_gen = codec_lib.genetic_bounds(codec, xl_ml, xu_ml)
             x_init_mm = codec_lib.minmax_normalize(x_init_ml, xl_ml, xu_ml)
@@ -365,12 +370,12 @@ class Moeva2:
             raise ValueError("minimize_class must be scalar or length n_states")
 
         chunk = self.max_states_per_call
+        if chunk and self.mesh is not None and chunk % self.mesh.size:
+            # round down to a mesh-size multiple (never up: the configured
+            # chunk is a device-memory / program-size ceiling) instead of
+            # erroring — e.g. the 500 default on an 8-device mesh runs as 496
+            chunk = max(chunk - chunk % self.mesh.size, self.mesh.size)
         if chunk and s > chunk:
-            if self.mesh is not None and chunk % self.mesh.size:
-                raise ValueError(
-                    f"max_states_per_call={chunk} must be a multiple of the "
-                    f"mesh size {self.mesh.size}"
-                )
             return self._generate_chunked(x, minimize_class, chunk)
         return self._generate_one(
             x, minimize_class,

@@ -95,8 +95,13 @@ class ObjectiveCalculator:
                 self._params_f64 = jax.tree.map(
                     lambda a: np.asarray(a, np.float64), self.classifier.params
                 )
+            from jax.experimental import enable_x64
+
             with contextlib.ExitStack() as stack:
-                stack.enter_context(jax.enable_x64(True))
+                # jax.experimental is the stable home of the context manager
+                # across the jax versions this repo runs on (0.4.x has no
+                # top-level jax.enable_x64)
+                stack.enter_context(enable_x64(True))
                 try:
                     stack.enter_context(jax.default_device(jax.devices("cpu")[0]))
                 except RuntimeError:

@@ -42,7 +42,9 @@ class AutoPGD(ConstrainedPGD):
     alpha_momentum: float = 0.75
     rho: float = 0.75
 
-    def _one_run(self, params, x_init, y, x_start):
+    def _one_run(self, params, x_init, y, x_start, eps, eps_step, max_iter):
+        # max_iter is trace-static here (generate guards it equal to
+        # self.max_iter): the checkpoint masks below are precomputed numpy
         n = x_init.shape[0]
         ckpts = checkpoint_schedule(self.max_iter)
         is_ckpt = np.zeros(self.max_iter + 1, dtype=bool)
@@ -71,13 +73,13 @@ class AutoPGD(ConstrainedPGD):
         def step_to(x, grad, eta):
             z = x + eta[:, None] * grad
             z = jnp.clip(z, *self.clip)
-            z = x_init + project_ball(z - x_init, self.eps, self.norm)
+            z = x_init + project_ball(z - x_init, eps, self.norm)
             return jnp.clip(z, *self.clip)
 
         f0 = tracking_loss(x_start)
         # effective reference init: auto_pgd.py:441's 2*eps_step is dead,
         # overwritten by eps_step at :459 before the loop
-        eta0 = jnp.full((n,), self.eps_step, x_init.dtype)
+        eta0 = jnp.full((n,), eps_step, x_init.dtype)
 
         carry0 = dict(
             x=x_start,
@@ -94,7 +96,7 @@ class AutoPGD(ConstrainedPGD):
 
         def body(i, c):
             grad, per, loss_class, cons, g = self._grad_and_terms(
-                params, c["x"], y, i
+                params, c["x"], y, i, self.max_iter
             )
             hist = (
                 self._hist_record(c["hist"], i, per, loss_class, cons, g, grad)
@@ -111,7 +113,7 @@ class AutoPGD(ConstrainedPGD):
                 c["x"] - c["x_prev"]
             )
             x_new = jnp.clip(x_new, *self.clip)
-            x_new = x_init + project_ball(x_new - x_init, self.eps, self.norm)
+            x_new = x_init + project_ball(x_new - x_init, eps, self.norm)
             x_new = jnp.clip(x_new, *self.clip)
             if "repair" in self.loss_evaluation:
                 x_new = jnp.where(

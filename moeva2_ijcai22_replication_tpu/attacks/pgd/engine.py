@@ -77,9 +77,13 @@ class ConstrainedPGD:
         )
         self._jit_attack = None
         self.loss_history: np.ndarray | None = None
+        #: number of times the attack program was (re)traced — one trace per
+        #: distinct executable. ε/ε-step are runtime arguments, so an ε sweep
+        #: over a cached engine keeps this at 1 (grid observability reads it).
+        self.trace_count = 0
 
     # -- loss ---------------------------------------------------------------
-    def _loss_weights(self, i, dtype):
+    def _loss_weights(self, i, dtype, max_iter):
         """Iteration schedule for (class, constraints) loss weights
         (``classifier.py:234-259``)."""
         le = self.loss_evaluation
@@ -87,7 +91,7 @@ class ConstrainedPGD:
             w_class = (i < 100).astype(dtype)
             return w_class, 1.0 - w_class
         if "constraints+flip+constraints" in le:
-            w_class = (i < self.max_iter // 2).astype(dtype)
+            w_class = (i < max_iter // 2).astype(dtype)
             return w_class, 1.0 - w_class
         if "constraints+flip+alternate" in le:
             w_class = ((i // self.alternate_frequency) % 2).astype(dtype)
@@ -131,14 +135,14 @@ class ConstrainedPGD:
             return 0.0, 1.0
         return 1.0, 0.0
 
-    def _grad_and_terms(self, params, x, y, i):
+    def _grad_and_terms(self, params, x, y, i, max_iter):
         """Gradient of the iteration-weighted ascent loss plus its per-sample
         components ``(grad, per, loss_class, cons, g)`` — the single shared
         definition for both PGD and AutoPGD steps (and their history)."""
 
         def loss_with_aux(xx):
             loss_class, cons, g = self._loss_terms(params, xx, y, i, with_g=True)
-            w_class, w_cons = self._loss_weights(i, loss_class.dtype)
+            w_class, w_cons = self._loss_weights(i, loss_class.dtype, max_iter)
             # violations must shrink while CE grows, hence the minus
             per = w_class * loss_class + w_cons * (-cons)
             return per.sum(), (per, loss_class, cons, g)
@@ -154,12 +158,12 @@ class ConstrainedPGD:
             self.constraints.repair(self.scaler.inverse(x))
         )
 
-    def _step_size(self, i, dtype):
+    def _step_size(self, i, dtype, eps, eps_step, max_iter):
         if "adaptive_eps_step" in self.loss_evaluation:
             # eps * 10^-(i // (max_iter//7) + 1) — atk.py:129-135
-            power = (i // max(self.max_iter // 7, 1) + 1).astype(dtype)
-            return self.eps * 10.0 ** (-power)
-        return self.eps_step
+            power = (i // jnp.maximum(max_iter // 7, 1) + 1).astype(dtype)
+            return eps * 10.0 ** (-power)
+        return eps_step
 
     def hist_column_names(self) -> list[str]:
         """Recorded-history column layout, the single source of truth for
@@ -193,23 +197,28 @@ class ConstrainedPGD:
         )
         return hist.at[i].set(stacked.astype(hist.dtype))
 
-    def _one_run(self, params, x_init, y, x_start):
+    def _one_run(self, params, x_init, y, x_start, eps, eps_step, max_iter):
         """Full iteration loop from ``x_start``; returns ``(x_adv, hist)``
         where hist is (max_iter, N, C) per-iteration loss components, or a
-        scalar when recording is off (subclasses override)."""
+        scalar when recording is off (subclasses override). ``eps``,
+        ``eps_step``, and (without history recording) ``max_iter`` are
+        runtime scalars, not trace constants — every (ε, budget) in a sweep
+        reuses the same compiled program."""
 
         def body(i, carry):
             x, hist = carry
-            grad, per, loss_class, cons, g = self._grad_and_terms(params, x, y, i)
+            grad, per, loss_class, cons, g = self._grad_and_terms(
+                params, x, y, i, max_iter
+            )
             if self.record_loss:
                 hist = self._hist_record(hist, i, per, loss_class, cons, g, grad)
             grad = jnp.where(jnp.isnan(grad), 0.0, grad)
             grad = jnp.where(self._mutable, grad, 0.0)
             grad = condition_grad(grad, self.norm)
 
-            x = x + self._step_size(i, x.dtype) * grad
+            x = x + self._step_size(i, x.dtype, eps, eps_step, max_iter) * grad
             x = jnp.clip(x, *self.clip)
-            x = x_init + project_ball(x - x_init, self.eps, self.norm)
+            x = x_init + project_ball(x - x_init, eps, self.norm)
             x = jnp.clip(x, *self.clip)
             if "repair" in self.loss_evaluation:
                 x = jnp.where(self._mutable, self._repair(x).astype(x.dtype), x)
@@ -217,21 +226,21 @@ class ConstrainedPGD:
 
         return jax.lax.fori_loop(
             0,
-            self.max_iter,
+            max_iter,
             body,
             (x_start, self._hist_init(x_init.shape[0], x_init.dtype)),
         )
 
-    def _random_start(self, key, x_init):
+    def _random_start(self, key, x_init, eps):
         k_dir, k_rad = jax.random.split(key)
         if is_inf(self.norm):
-            pert = self.eps * jax.random.uniform(
+            pert = eps * jax.random.uniform(
                 k_dir, x_init.shape, x_init.dtype, -1.0, 1.0
             )
         else:
             d = jax.random.normal(k_dir, x_init.shape, x_init.dtype)
             d = d / (jnp.sqrt((d * d).sum(-1, keepdims=True)) + 1e-12)
-            radius = self.eps * jax.random.uniform(
+            radius = eps * jax.random.uniform(
                 k_rad, x_init.shape[:-1] + (1,), x_init.dtype
             ) ** (1.0 / x_init.shape[-1])
             pert = d * radius
@@ -239,17 +248,32 @@ class ConstrainedPGD:
             x_init + jnp.where(self._mutable, pert, 0.0), *self.clip
         )
 
+    def _runtime_max_iter(self) -> bool:
+        """True when the iteration budget can be a runtime argument of the
+        compiled program (a dynamic ``fori_loop`` trip count): plain
+        ConstrainedPGD without history recording. AutoPGD's checkpoint masks
+        and the recorded-history buffer are shaped by ``max_iter`` at trace
+        time, so those programs keep it baked (one executable per budget)."""
+        return type(self) is ConstrainedPGD and not self.record_loss
+
     def _build(self):
-        def attack(params, x_init, y, key):
+        def attack(params, x_init, y, key, eps, eps_step, max_iter):
+            self.trace_count += 1  # body runs once per (re)trace
             # No restarts: return the attacked batch as-is (ART PGD semantics —
             # success filtering only arbitrates BETWEEN multiple restarts).
             if self.num_random_init == 0:
-                return self._one_run(params, x_init, y, x_init)
+                return self._one_run(
+                    params, x_init, y, x_init, eps, eps_step, max_iter
+                )
 
             def restart(r, carry):
                 best_x, best_success, best_hist = carry
-                x_start = self._random_start(jax.random.fold_in(key, r), x_init)
-                x_adv, hist = self._one_run(params, x_init, y, x_start)
+                x_start = self._random_start(
+                    jax.random.fold_in(key, r), x_init, eps
+                )
+                x_adv, hist = self._one_run(
+                    params, x_init, y, x_start, eps, eps_step, max_iter
+                )
                 probs = Surrogate(self.classifier.model, params).predict_proba(x_adv)
                 success = probs.argmax(-1) != y  # untargeted flip
                 if self.targeted:
@@ -279,25 +303,70 @@ class ConstrainedPGD:
 
         return attack
 
-    def generate(self, x_scaled: np.ndarray, y: np.ndarray) -> np.ndarray:
-        """Attack scaled candidates ``x_scaled`` with true labels ``y``."""
+    def generate(
+        self,
+        x_scaled: np.ndarray,
+        y: np.ndarray,
+        *,
+        eps: float | None = None,
+        eps_step: float | None = None,
+        max_iter: int | None = None,
+    ) -> np.ndarray:
+        """Attack scaled candidates ``x_scaled`` with true labels ``y``.
+
+        ``eps``/``eps_step``/``max_iter`` default to the constructor values
+        but are fed to the compiled program as runtime scalars where the
+        program allows it (see :meth:`_runtime_max_iter`): sweeping ε — and,
+        for plain PGD without history, the budget — over one engine instance
+        dispatches the same executable (no retrace, no recompile)."""
+        if eps is None:
+            eps = self.eps
+        if eps_step is None:
+            eps_step = self.eps_step
+        if max_iter is None:
+            max_iter = self.max_iter
+        runtime_iters = self._runtime_max_iter()
+        if not runtime_iters and int(max_iter) != self.max_iter:
+            raise ValueError(
+                f"max_iter={max_iter} differs from the trace-static budget "
+                f"{self.max_iter}: this program bakes its iteration count "
+                "(AutoPGD / history recording); build an engine per budget"
+            )
         if self._jit_attack is None:
-            self._jit_attack = jax.jit(self._build())
+            # the baked-budget programs take max_iter as a static arg so the
+            # jitted callable's signature stays uniform across both modes
+            self._jit_attack = jax.jit(
+                self._build(),
+                static_argnums=() if runtime_iters else (6,),
+            )
+        mi = (
+            jnp.asarray(max_iter, jnp.int32)
+            if runtime_iters
+            else int(max_iter)
+        )
         args = (
             self.classifier.params,
             jnp.asarray(x_scaled, self.dtype),
             jnp.asarray(y, jnp.int32),
             jax.random.PRNGKey(self.seed),
+            jnp.asarray(eps, self.dtype),
+            jnp.asarray(eps_step, self.dtype),
         )
         if self.mesh is not None:
             from ..sharding import shard_states_args
 
-            params, x_dev, y_dev, key = args
-            (params, key), (x_dev, y_dev) = shard_states_args(
-                self.mesh, self.states_axis, (params, key), (x_dev, y_dev)
+            params, x_dev, y_dev, key, eps_d, step_d = args
+            repl_in = (params, key, eps_d, step_d) + (
+                (mi,) if runtime_iters else ()
             )
-            args = (params, x_dev, y_dev, key)
-        out, hist = self._jit_attack(*args)
+            repl_out, (x_dev, y_dev) = shard_states_args(
+                self.mesh, self.states_axis, repl_in, (x_dev, y_dev)
+            )
+            params, key, eps_d, step_d = repl_out[:4]
+            if runtime_iters:
+                mi = repl_out[4]
+            args = (params, x_dev, y_dev, key, eps_d, step_d)
+        out, hist = self._jit_attack(*args, mi)
         # (N, max_iter, C) — runners add the reference's unit axis on save
         # (01_pgd_united.py:196-199).
         self.loss_history = (

@@ -1,7 +1,12 @@
 """Shared runner plumbing: artifact loading, skip-if-done, SAT registry.
 
 Mirrors the setup blocks both reference entry points share
-(``04_moeva.py:41-64``, ``01_pgd_united.py:50-77``).
+(``04_moeva.py:41-64``, ``01_pgd_united.py:50-77``) — with one grid-scale
+difference: the loaders are memoized (:class:`ArtifactCache`, keyed by
+resolved paths + mtime/size) and runners can reuse attack-engine instances
+across grid points (:func:`cached_engine`), so an in-process sweep reads
+constraints / candidates / scalers / surrogate weights from disk once per
+grid and shares compiled executables instead of rebuilding per point.
 """
 
 from __future__ import annotations
@@ -16,6 +21,98 @@ from ..domains.lcld_sat import make_lcld_sat_builder
 from ..models.scalers import MinMaxParams, load_joblib_scaler
 from ..utils.config import get_dict_hash
 from ..utils.in_out import load_model
+
+
+class ArtifactCache:
+    """Path-keyed memoizer for on-disk experiment artifacts.
+
+    An entry is valid while every file it was built from keeps its
+    (mtime_ns, size) stamp; a touched or rewritten file invalidates exactly
+    that entry on the next lookup. Hit/miss counters feed the grid report.
+    """
+
+    def __init__(self):
+        self._entries: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _stamp(paths: tuple) -> tuple:
+        return tuple(
+            (p, st.st_mtime_ns, st.st_size)
+            for p, st in ((p, os.stat(p)) for p in paths)
+        )
+
+    def get(self, kind: str, paths, extra, builder):
+        """Return ``builder()``'s result memoized under ``(kind, paths,
+        extra)``, rebuilt when any of ``paths`` changed on disk."""
+        paths = tuple(os.path.abspath(p) for p in paths)
+        key = (kind, paths, extra)
+        stamp = self._stamp(paths)
+        entry = self._entries.get(key)
+        if entry is not None and entry[0] == stamp:
+            self.hits += 1
+            return entry[1]
+        self.misses += 1
+        value = builder()
+        self._entries[key] = (stamp, value)
+        return value
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses, "entries": len(self._entries)}
+
+    def clear(self):
+        self._entries.clear()
+
+
+#: process-wide artifact cache: one disk read per artifact per grid (module
+#: level so subprocess-mode grid points — one process per point — still work,
+#: they just never hit).
+ARTIFACTS = ArtifactCache()
+
+
+class EngineCache:
+    """Static-config-keyed attack-engine instances.
+
+    An engine owns its jitted program, so reusing the instance across grid
+    points reuses the traced/compiled executable in-process (the persistent
+    XLA cache only amortises across processes). Keys must contain every
+    constructor argument that shapes the compiled program; run-identity
+    knobs that only feed host-side dispatch (seed, checkpoint paths, MoEvA's
+    ``n_gen``) are reassigned on the cached instance per point.
+    """
+
+    def __init__(self):
+        self._engines: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple, builder):
+        engine = self._engines.get(key)
+        if engine is not None:
+            self.hits += 1
+            return engine
+        self.misses += 1
+        engine = builder()
+        self._engines[key] = engine
+        return engine
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "engines": len(self._engines),
+            "traces": sum(
+                getattr(e, "trace_count", 0) for e in self._engines.values()
+            ),
+        }
+
+    def clear(self):
+        self._engines.clear()
+
+
+#: process-wide engine cache (same lifetime rationale as ARTIFACTS).
+ENGINES = EngineCache()
 
 
 def setup_jax_cache(config: dict | None = None) -> None:
@@ -43,11 +140,14 @@ def metrics_path_for(config: dict, mid_fix: str) -> str:
     return f"{out_dir}/metrics_{mid_fix}_{get_dict_hash(config)}.json"
 
 
-def should_skip(config: dict, mid_fix: str) -> bool:
+def should_skip(config: dict, mid_fix: str, pipeline=None) -> bool:
     """Config-hash idempotency (``04_moeva.py:31-36``): a metrics file for
-    this exact config means the experiment already ran."""
+    this exact config means the experiment already ran. Under a grid pipeline
+    the metrics write may still sit in the background writer's queue, so a
+    queued-but-unwritten hash also skips (idempotency must not depend on
+    writer latency)."""
     path = metrics_path_for(config, mid_fix)
-    if os.path.exists(path):
+    if os.path.exists(path) or (pipeline is not None and pipeline.is_pending(path)):
         print(
             f"Configuration with hash {get_dict_hash(config)} already "
             "executed. Skipping"
@@ -58,38 +158,55 @@ def should_skip(config: dict, mid_fix: str) -> bool:
 
 def load_constraints(config: dict):
     """Constraint plugin from the registry, with optional explicit
-    important-features path (``04_moeva.py:43-53``)."""
-    cls = get_constraints_class(config["project_name"])
-    kwargs = {}
-    if config["paths"].get("important_features"):
-        kwargs["important_features_path"] = config["paths"]["important_features"]
-    return cls(
-        config["paths"]["features"], config["paths"]["constraints"], **kwargs
-    )
+    important-features path (``04_moeva.py:43-53``). Memoized: every grid
+    point naming the same CSVs shares one constraints object."""
+    project = config["project_name"]
+    paths = [config["paths"]["features"], config["paths"]["constraints"]]
+    important = config["paths"].get("important_features")
+    if important:
+        paths.append(important)
+
+    def build():
+        cls = get_constraints_class(project)
+        kwargs = (
+            {"important_features_path": important} if important else {}
+        )
+        return cls(paths[0], paths[1], **kwargs)
+
+    return ARTIFACTS.get("constraints", paths, (project, bool(important)), build)
 
 
 def load_candidates(config: dict) -> np.ndarray:
     """Candidate set, sliced to the configured window; ``n_initial_state=-1``
-    keeps everything (``04_moeva.py:55-58``)."""
-    x = np.load(config["paths"]["x_candidates"])
+    keeps everything (``04_moeva.py:55-58``). The full ``np.load`` is
+    memoized per file; slicing is per-config (views of the cached array —
+    runners treat candidates as read-only)."""
+    path = config["paths"]["x_candidates"]
+    x = ARTIFACTS.get("candidates", [path], None, lambda: np.load(path))
     offset, count = config["initial_state_offset"], config["n_initial_state"]
     return x if count == -1 else x[offset : offset + count]
 
 
 def load_scaler(config: dict) -> MinMaxParams:
-    return load_joblib_scaler(config["paths"]["ml_scaler"])
+    path = config["paths"]["ml_scaler"]
+    return ARTIFACTS.get("scaler", [path], None, lambda: load_joblib_scaler(path))
 
 
 def load_surrogate(config: dict):
-    model = load_model(config["paths"]["model"])
-    from ..models.io import Surrogate
+    path = config["paths"]["model"]
 
-    if not isinstance(model, Surrogate):
-        raise TypeError(
-            f"{config['paths']['model']} is not a device-runnable surrogate; "
-            "attack runners need a Keras/Flax artifact"
-        )
-    return model
+    def build():
+        model = load_model(path)
+        from ..models.io import Surrogate
+
+        if not isinstance(model, Surrogate):
+            raise TypeError(
+                f"{path} is not a device-runnable surrogate; "
+                "attack runners need a Keras/Flax artifact"
+            )
+        return model
+
+    return ARTIFACTS.get("surrogate", [path], None, build)
 
 
 def get_sat_builder(project_name: str, constraints):
@@ -108,8 +225,13 @@ def evaluation_constraints(config: dict, attack_constraints):
     ev = config.get("evaluation")
     if not ev:
         return attack_constraints
-    cls = get_constraints_class(ev["project_name"])
-    return cls(config["paths"]["features"], ev["constraints"])
+    paths = [config["paths"]["features"], ev["constraints"]]
+    return ARTIFACTS.get(
+        "constraints",
+        paths,
+        (ev["project_name"], False),
+        lambda: get_constraints_class(ev["project_name"])(paths[0], paths[1]),
+    )
 
 
 def build_mesh(config: dict):

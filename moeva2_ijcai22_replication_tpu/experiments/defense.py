@@ -413,7 +413,12 @@ def run(config: dict) -> dict:
                 "nn_moeva on all MoEvA successes (botnet semantics)"
             )
             moeva_mask = np.ones(len(x_adv_moeva), dtype=bool)
-            gradient_mask = np.ones(len(x_adv_gradient), dtype=bool)
+            # the botnet-semantics fallback is for nn_moeva only: nn_gradient
+            # keeps the LCLD intersection semantics and retrains on zero
+            # adversarials (honestly degenerating to base weights) — both
+            # when the gradient attack found nothing (x_adv_gradient is
+            # empty) and when its successes are merely disjoint from MoEvA's
+            gradient_mask = np.zeros(len(x_adv_gradient), dtype=bool)
         else:
             moeva_mask = both[adv_moeva_index]
             gradient_mask = both[adv_gradient_index]

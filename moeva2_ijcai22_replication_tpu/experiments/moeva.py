@@ -6,6 +6,17 @@ config-hash skip, constraint check, timed attack, result artifacts
 per-ε success rates, and ``metrics_moeva_{hash}.json``. The attack itself
 runs as one jitted program over all initial states (optionally sharded over
 a device mesh via ``system.mesh_devices``) instead of a joblib process pool.
+
+Grid-scale execution (docs/DESIGN.md §"Grid execution pipeline"): the
+``Moeva2`` engine is cached across grid points keyed by its static config —
+seed / budget / checkpoint path are host-side dispatch knobs reassigned per
+point, so a budget sweep shares one engine (and its compiled ``init``
+program; each distinct budget adds one ``segment`` trace) — and, when a
+:class:`..experiments.pipeline.GridPipeline` is passed, per-ε evaluation and
+artifact serialization run on the grid's background writer while the device
+starts the next point's attack. Mid-run checkpointing happens inside
+``generate`` on the launching thread, before finalize is queued, so crash
+recovery semantics are unchanged.
 """
 
 from __future__ import annotations
@@ -25,15 +36,67 @@ from ..utils.streaming import stream_for
 from . import common
 
 
-def run(config: dict):
+def _cached_engine(config, surrogate, constraints, scaler):
+    """Engine instance shared across grid points with the same static
+    config. ``n_gen``/``seed``/checkpointing only steer host-side dispatch
+    (the per-segment scan length is a jit static argument), so they are
+    per-point attributes, not key material."""
+    mesh_devices = int(config.get("system", {}).get("mesh_devices", 0) or 0)
+    key = (
+        "moeva",
+        id(surrogate),
+        id(constraints),
+        id(scaler),
+        str(config["norm"]),
+        config["n_pop"],
+        config["n_offsprings"],
+        config.get("init", "tile"),
+        config.get("init_eps", 0.1),
+        config.get("init_ratio", 0.5),
+        config.get("archive_size", 0),
+        config.get("assoc_block") or None,
+        config.get("max_states_per_call") or None,
+        config.get("save_history") or None,
+        mesh_devices,
+    )
+
+    def build():
+        return Moeva2(
+            classifier=surrogate,
+            constraints=constraints,
+            ml_scaler=scaler,
+            norm=config["norm"],
+            n_gen=config["budget"],
+            n_pop=config["n_pop"],
+            n_offsprings=config["n_offsprings"],
+            init=config.get("init", "tile"),
+            init_eps=config.get("init_eps", 0.1),
+            init_ratio=config.get("init_ratio", 0.5),
+            archive_size=config.get("archive_size", 0),
+            # association formulation (None = one-shot einsum; an int =
+            # blocked scan with that direction-block size, bit-identical)
+            assoc_block=config.get("assoc_block") or None,
+            max_states_per_call=config.get("max_states_per_call") or None,
+            save_history=config.get("save_history") or None,
+            mesh=common.build_mesh(config),
+        )
+
+    return common.ENGINES.get(key, build)
+
+
+def run(config: dict, pipeline=None):
     """Execute one MoEvA2 experiment; returns the metrics dict, or None when
-    the config hash already has results (skip-if-done)."""
+    the config hash already has results (skip-if-done) — or when ``pipeline``
+    is given, in which case evaluation/serialization are deferred to the
+    grid's background writer (drained by the grid runner before it returns)."""
     common.setup_jax_cache(config)
     out_dir = config["dirs"]["results"]
     config_hash = get_dict_hash(config)
     mid_fix = f"{config['attack_name']}"
     metrics_path = common.metrics_path_for(config, mid_fix)
-    if common.should_skip(config, mid_fix):
+    if common.should_skip(config, mid_fix, pipeline):
+        if pipeline is not None:
+            pipeline.point(mid_fix, config_hash, None, skipped=True)
         return None
 
     os.makedirs(out_dir, exist_ok=True)
@@ -50,33 +113,18 @@ def run(config: dict):
         # ----- Check constraints (04_moeva.py:64)
         constraints.check_constraints_error(x_initial_states)
 
-    start_time = time.time()
-    moeva = Moeva2(
-        classifier=surrogate,
-        constraints=constraints,
-        ml_scaler=scaler,
-        norm=config["norm"],
-        n_gen=config["budget"],
-        n_pop=config["n_pop"],
-        n_offsprings=config["n_offsprings"],
-        seed=config["seed"],
-        init=config.get("init", "tile"),
-        init_eps=config.get("init_eps", 0.1),
-        init_ratio=config.get("init_ratio", 0.5),
-        archive_size=config.get("archive_size", 0),
-        # association formulation (None = one-shot einsum; an int = blocked
-        # scan with that direction-block size, bit-identical results)
-        assoc_block=config.get("assoc_block") or None,
-        max_states_per_call=config.get("max_states_per_call") or None,
-        save_history=config.get("save_history") or None,
+        moeva = _cached_engine(config, surrogate, constraints, scaler)
+        # per-point run identity: host-side dispatch knobs on the cached engine
+        moeva.n_gen = config["budget"]
+        moeva.seed = config["seed"]
         # crash recovery: a rerun of this config hash resumes mid-attack
         # from the last ``checkpoint_every``-generation boundary instead of
         # generation 0 (config-hash skip only covers *completed* runs)
-        checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
-        checkpoint_path=f"{out_dir}/checkpoint_{mid_fix}_{config_hash}.npz",
-        mesh=common.build_mesh(config),
-    )
-    with timer.phase("attack"), maybe_profile(
+        moeva.checkpoint_every = int(config.get("checkpoint_every", 0) or 0)
+        moeva.checkpoint_path = f"{out_dir}/checkpoint_{mid_fix}_{config_hash}.npz"
+
+    start_time = time.time()
+    with timer.attack(moeva), maybe_profile(
         config.get("system", {}).get("profile_dir")
     ):
         # candidate counts are data-dependent: pad to a mesh multiple, trim
@@ -84,7 +132,6 @@ def run(config: dict):
         result = moeva.generate(x_run, 1)
     consumed_time = time.time() - start_time
 
-    # ----- Persist populations ((S, P, D) ndarray — results_to_numpy_results)
     x_attacks = result.x_ml[:n_orig]
     if config.get("reconstruction"):
         # Strip the stale augmented columns and recompute them from the
@@ -94,56 +141,77 @@ def run(config: dict):
         x_attacks = np.asarray(
             augmentation.augment(x_attacks[..., :-n_pairs], important)
         )
-    save_to_file(x_attacks, f"{out_dir}/x_attacks_{mid_fix}_{config_hash}.npy")
 
-    if config.get("save_history") and len(result.history) > 1:
-        # (n_gen-1, S, n_off, C) per-generation objective history
-        np.save(
-            f"{out_dir}/x_history_{mid_fix}_{config_hash}.npy",
-            np.stack(result.history[1:])[:, :n_orig],
-        )
+    def finalize():
+        # ----- Persist populations ((S, P, D) ndarray — results_to_numpy_results)
+        with timer.phase("write"):
+            save_to_file(
+                x_attacks, f"{out_dir}/x_attacks_{mid_fix}_{config_hash}.npy"
+            )
+            if config.get("save_history") and len(result.history) > 1:
+                # (n_gen-1, S, n_off, C) per-generation objective history
+                np.save(
+                    f"{out_dir}/x_history_{mid_fix}_{config_hash}.npy",
+                    np.stack(result.history[1:])[:, :n_orig],
+                )
 
-    # ----- Success rates per ε (04_moeva.py:112-131)
-    with timer.phase("evaluate"):
-        eval_constraints = common.evaluation_constraints(config, constraints)
-        calc = ObjectiveCalculator(
-            classifier=surrogate,
-            constraints=eval_constraints,
-            thresholds={"f1": config["misclassification_threshold"], "f2": 0.0},
-            min_max_scaler=scaler,
-            ml_scaler=scaler,
-            minimize_class=1,
-            norm=config["norm"],
-        )
-        # [cv, f1, f2] is ε-independent: evaluate once, re-threshold per ε
-        vals = calc.objectives(x_initial_states, x_attacks)
-        objective_lists = []
-        for eps in config["eps_list"]:
-            calc.thresholds = {
-                "f1": config["misclassification_threshold"],
-                "f2": eps,
-            }
-            df = calc.success_rate_3d_df(x_initial_states, x_attacks, vals)
-            objective_lists.append(df.to_dict(orient="records")[0])
+        # ----- Success rates per ε (04_moeva.py:112-131)
+        with timer.phase("evaluate"):
+            eval_constraints = common.evaluation_constraints(config, constraints)
+            calc = ObjectiveCalculator(
+                classifier=surrogate,
+                constraints=eval_constraints,
+                thresholds={
+                    "f1": config["misclassification_threshold"],
+                    "f2": 0.0,
+                },
+                min_max_scaler=scaler,
+                ml_scaler=scaler,
+                minimize_class=1,
+                norm=config["norm"],
+            )
+            # [cv, f1, f2] is ε-independent: evaluate once, re-threshold per ε
+            vals = calc.objectives(x_initial_states, x_attacks)
+            objective_lists = []
+            for eps in config["eps_list"]:
+                calc.thresholds = {
+                    "f1": config["misclassification_threshold"],
+                    "f2": eps,
+                }
+                df = calc.success_rate_3d_df(x_initial_states, x_attacks, vals)
+                objective_lists.append(df.to_dict(orient="records")[0])
 
-    metrics = {
-        "objectives_list": objective_lists,
-        "time": consumed_time,
-        "timings": timer.spans,
-        "config": config,
-        "config_hash": config_hash,
-    }
-    # Comet-equivalent event stream (src/utils/comet.py parity; off by
-    # default, enabled by config `streaming`).
-    with stream_for(config, mid_fix, config_hash) as stream:
-        stream.log_parameters(config)
-        stream.log_metric("time", consumed_time)
-        for eps, objectives in zip(config["eps_list"], objective_lists):
-            for k, v in objectives.items():
-                stream.log_metric(f"eps{eps}_{k}", v)
-    json_to_file(metrics, metrics_path)
-    save_config(config, f"{out_dir}/config_{mid_fix}_")
-    return metrics
+        with timer.phase("write"):
+            # Comet-equivalent event stream (src/utils/comet.py parity; off by
+            # default, enabled by config `streaming`).
+            with stream_for(config, mid_fix, config_hash) as stream:
+                stream.log_parameters(config)
+                stream.log_metric("time", consumed_time)
+                for eps, objectives in zip(config["eps_list"], objective_lists):
+                    for k, v in objectives.items():
+                        stream.log_metric(f"eps{eps}_{k}", v)
+
+        # metrics assembled AFTER the write phase closes so its 'timings'
+        # include the artifact-write spans; the metrics JSON itself still
+        # lands last, preserving the "metrics exists => siblings exist"
+        # invariant should_skip relies on
+        metrics = {
+            "objectives_list": objective_lists,
+            "time": consumed_time,
+            "timings": timer.spans,
+            "counters": timer.counters,
+            "config": config,
+            "config_hash": config_hash,
+        }
+        json_to_file(metrics, metrics_path)
+        save_config(config, f"{out_dir}/config_{mid_fix}_")
+        return metrics
+
+    if pipeline is not None:
+        pipeline.point(mid_fix, config_hash, timer)
+        pipeline.submit(mid_fix, metrics_path, finalize)
+        return None
+    return finalize()
 
 
 if __name__ == "__main__":

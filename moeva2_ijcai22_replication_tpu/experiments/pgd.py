@@ -6,6 +6,15 @@ by ``loss_evaluation``, scaled-space attack with mutable-feature masking,
 directional integer rounding toward the original, SAT repair with the
 gradient output as hot start, reconstruction, success rates, and
 ``metrics_pgd_{loss}_{hash}.json`` + success-rate CSV.
+
+Grid-scale execution (docs/DESIGN.md §"Grid execution pipeline"): the attack
+engine is cached across grid points keyed by its *static* config — ε and
+ε-step are runtime arguments of the compiled program, so an ε sweep at a
+fixed loss strategy dispatches one executable — and, when a
+:class:`..experiments.pipeline.GridPipeline` is passed, evaluation and all
+artifact serialization run on the grid's background writer while the device
+starts the next point's attack. Device math is unaffected: pipelining only
+reorders host work, so outputs for a fixed config stay bit-identical.
 """
 
 from __future__ import annotations
@@ -26,15 +35,65 @@ from ..utils.streaming import stream_for
 from . import common
 
 
-def run(config: dict):
+def _cached_attack(config, surrogate, constraints, scaler):
+    """Engine instance shared across grid points with the same static
+    config. ε/ε-step/seed — and, for plain PGD without history, the budget —
+    are per-point runtime values (`generate` args / host-side attribute), so
+    they are deliberately NOT in the key."""
+    cls = AutoPGD if "autopgd" in config["loss_evaluation"] else ConstrainedPGD
+    num_random_init = config.get("nb_random", 1 if cls is AutoPGD else 0)
+    record_loss = config.get("save_history") or None
+    record_grad_norm = bool(config.get("save_grad_norm"))
+    mesh_devices = int(config.get("system", {}).get("mesh_devices", 0) or 0)
+    # AutoPGD / history programs bake the budget (see _runtime_max_iter):
+    # those get one engine per budget; plain PGD shares across budgets
+    budget_is_static = cls is AutoPGD or bool(record_loss)
+    key = (
+        cls.__name__,
+        id(surrogate),
+        id(constraints),
+        id(scaler),
+        int(config["budget"]) if budget_is_static else None,
+        str(config["norm"]),
+        config["loss_evaluation"],
+        config.get("constraints_optim", "sum"),
+        num_random_init,
+        record_loss,
+        record_grad_norm,
+        mesh_devices,
+    )
+
+    def build():
+        return cls(
+            classifier=surrogate,
+            constraints=constraints,
+            scaler=scaler,
+            max_iter=int(config["budget"]),
+            norm=config["norm"],
+            loss_evaluation=config["loss_evaluation"],
+            constraints_optim=config.get("constraints_optim", "sum"),
+            num_random_init=num_random_init,
+            record_loss=record_loss,
+            record_grad_norm=record_grad_norm,
+            mesh=common.build_mesh(config),
+        )
+
+    return common.ENGINES.get(key, build)
+
+
+def run(config: dict, pipeline=None):
     """Execute one gradient-attack experiment; returns the metrics dict, or
-    None when the config hash already has results."""
+    None when the config hash already has results — or when ``pipeline`` is
+    given, in which case evaluation/serialization are deferred to the grid's
+    background writer (drained by the grid runner before it returns)."""
     common.setup_jax_cache(config)
     out_dir = config["dirs"]["results"]
     config_hash = get_dict_hash(config)
     mid_fix = f"{config['attack_name']}_{config['loss_evaluation']}"
     metrics_path = common.metrics_path_for(config, mid_fix)
-    if common.should_skip(config, mid_fix):
+    if common.should_skip(config, mid_fix, pipeline):
+        if pipeline is not None:
+            pipeline.point(mid_fix, config_hash, None, skipped=True)
         return None
 
     os.makedirs(out_dir, exist_ok=True)
@@ -48,40 +107,17 @@ def run(config: dict):
         scaler = common.load_scaler(config)
         surrogate = common.load_surrogate(config)
         constraints.check_constraints_error(x_initial)
+        attack = _cached_attack(config, surrogate, constraints, scaler)
+        attack.seed = config["seed"]
 
     start_time = time.time()
     # Use only half ε if SAT runs after (01_pgd_united.py:97).
     per_attack_eps = config["eps"] / 2 if apply_sat else config["eps"]
+    eps_run = per_attack_eps - 0.000001
+    # AutoPGD defaults (01_pgd_united.py:99-111); plain PGD uses a fixed step.
+    eps_step_run = per_attack_eps / 3 if isinstance(attack, AutoPGD) else 0.1
 
-    cls = AutoPGD if "autopgd" in config["loss_evaluation"] else ConstrainedPGD
-    kwargs = dict(
-        classifier=surrogate,
-        constraints=constraints,
-        scaler=scaler,
-        eps=per_attack_eps - 0.000001,
-        max_iter=int(config["budget"]),
-        norm=config["norm"],
-        loss_evaluation=config["loss_evaluation"],
-        constraints_optim=config.get("constraints_optim", "sum"),
-        seed=config["seed"],
-        record_loss=config.get("save_history") or None,
-        record_grad_norm=bool(config.get("save_grad_norm")),
-        mesh=common.build_mesh(config),
-    )
-    if cls is AutoPGD:
-        # AutoPGD defaults (01_pgd_united.py:99-111)
-        kwargs.update(
-            eps_step=per_attack_eps / 3,
-            num_random_init=config.get("nb_random", 1),
-        )
-    else:
-        kwargs.update(
-            eps_step=0.1,
-            num_random_init=config.get("nb_random", 0),
-        )
-    attack = cls(**kwargs)
-
-    with timer.phase("attack"), maybe_profile(
+    with timer.attack(attack), maybe_profile(
         config.get("system", {}).get("profile_dir")
     ):
         x_scaled = np.asarray(scaler.transform(x_initial))
@@ -91,9 +127,17 @@ def run(config: dict):
         # candidate counts are data-dependent: pad to a mesh multiple, trim
         x_run, n_orig = common.pad_states(x_scaled, attack.mesh)
         y_run, _ = common.pad_states(y, attack.mesh)
-        x_adv_scaled = attack.generate(x_run, y_run)[:n_orig]
-        if attack.loss_history is not None:
-            attack.loss_history = attack.loss_history[:n_orig]
+        x_adv_scaled = attack.generate(
+            x_run, y_run, eps=eps_run, eps_step=eps_step_run,
+            max_iter=int(config["budget"]),
+        )[:n_orig]
+        # snapshot per-run engine outputs NOW: a cached engine may be
+        # re-dispatched for the next grid point while the writer thread is
+        # still finalizing this one
+        loss_history = attack.loss_history
+        if loss_history is not None:
+            loss_history = loss_history[:n_orig]
+        hist_names = attack.hist_column_names()
         x_attacks = np.asarray(scaler.inverse(x_adv_scaled))
 
         # Directional integer rounding (01_pgd_united.py:130-137).
@@ -127,61 +171,75 @@ def run(config: dict):
     if x_attacks.ndim == 2:
         x_attacks = x_attacks[:, np.newaxis, :]
 
-    with timer.phase("evaluate"):
-        eval_constraints = common.evaluation_constraints(config, constraints)
-        calc = ObjectiveCalculator(
-            classifier=surrogate,
-            constraints=eval_constraints,
-            thresholds={
-                "f1": config["misclassification_threshold"],
-                "f2": config["eps"],
-            },
-            min_max_scaler=scaler,
-            ml_scaler=scaler,
-            minimize_class=1,
-            norm=config["norm"],
-        )
-        success_rate_df = calc.success_rate_3d_df(x_initial, x_attacks)
-    print(success_rate_df)
+    def finalize():
+        with timer.phase("evaluate"):
+            eval_constraints = common.evaluation_constraints(config, constraints)
+            calc = ObjectiveCalculator(
+                classifier=surrogate,
+                constraints=eval_constraints,
+                thresholds={
+                    "f1": config["misclassification_threshold"],
+                    "f2": config["eps"],
+                },
+                min_max_scaler=scaler,
+                ml_scaler=scaler,
+                minimize_class=1,
+                norm=config["norm"],
+            )
+            success_rate_df = calc.success_rate_3d_df(x_initial, x_attacks)
+        print(success_rate_df)
 
-    np.save(f"{out_dir}/x_attacks_{mid_fix}_{config_hash}.npy", x_attacks)
-    if config.get("save_history") and attack.loss_history is not None:
-        # (N, max_iter, 1, C) loss-component curves, the reference's saved
-        # layout (01_pgd_united.py:196-199; C = 3 for "reduced", 3+K "full").
-        np.save(
-            f"{out_dir}/x_history_{config_hash}.npy",
-            attack.loss_history[:, :, np.newaxis, :],
-        )
+        objectives = success_rate_df.to_dict(orient="records")[0]
+        with timer.phase("write"):
+            np.save(f"{out_dir}/x_attacks_{mid_fix}_{config_hash}.npy", x_attacks)
+            if config.get("save_history") and loss_history is not None:
+                # (N, max_iter, 1, C) loss-component curves, the reference's
+                # saved layout (01_pgd_united.py:196-199; C = 3 for "reduced",
+                # 3+K "full").
+                np.save(
+                    f"{out_dir}/x_history_{config_hash}.npy",
+                    loss_history[:, :, np.newaxis, :],
+                )
+            # Comet-equivalent event stream: run params, final rates, and
+            # (when loss history was recorded) the per-iteration
+            # loss/grad-norm curves the reference pushed to Comet from inside
+            # the loop (pgd/classifier.py:183-217, atk.py:201-226).
+            with stream_for(config, mid_fix, config_hash) as stream:
+                stream.log_parameters(config)
+                stream.log_metric("time", consumed_time)
+                for k, v in objectives.items():
+                    stream.log_metric(k, v)
+                if loss_history is not None:
+                    mean_curves = loss_history.mean(axis=0)  # (max_iter, C)
+                    scalar = {"loss", "loss_class", "cons_sum", "grad_norm"}
+                    for j, name in enumerate(hist_names):
+                        if name in scalar:  # skip per-constraint g1..gK cols
+                            stream.log_series(f"mean_{name}", mean_curves[:, j])
+            success_rate_df.to_csv(
+                f"{out_dir}/success_rate_{mid_fix}_{config_hash}.csv", index=False
+            )
 
-    metrics = {
-        "objectives": success_rate_df.to_dict(orient="records")[0],
-        "time": consumed_time,
-        "timings": timer.spans,
-        "config": config,
-        "config_hash": config_hash,
-    }
-    # Comet-equivalent event stream: run params, final rates, and (when loss
-    # history was recorded) the per-iteration loss/grad-norm curves the
-    # reference pushed to Comet from inside the loop
-    # (pgd/classifier.py:183-217, atk.py:201-226).
-    with stream_for(config, mid_fix, config_hash) as stream:
-        stream.log_parameters(config)
-        stream.log_metric("time", consumed_time)
-        for k, v in metrics["objectives"].items():
-            stream.log_metric(k, v)
-        if attack.loss_history is not None:
-            mean_curves = attack.loss_history.mean(axis=0)  # (max_iter, C)
-            names = attack.hist_column_names()
-            scalar = {"loss", "loss_class", "cons_sum", "grad_norm"}
-            for j, name in enumerate(names):
-                if name in scalar:  # skip the per-constraint g1..gK columns
-                    stream.log_series(f"mean_{name}", mean_curves[:, j])
-    success_rate_df.to_csv(
-        f"{out_dir}/success_rate_{mid_fix}_{config_hash}.csv", index=False
-    )
-    json_to_file(metrics, metrics_path)
-    save_config(config, f"{out_dir}/config_{mid_fix}_")
-    return metrics
+        # metrics assembled AFTER the write phase closes so its 'timings'
+        # include the artifact-write span; the metrics JSON itself still
+        # lands last, preserving the "metrics exists => siblings exist"
+        # invariant should_skip relies on
+        metrics = {
+            "objectives": objectives,
+            "time": consumed_time,
+            "timings": timer.spans,
+            "counters": timer.counters,
+            "config": config,
+            "config_hash": config_hash,
+        }
+        json_to_file(metrics, metrics_path)
+        save_config(config, f"{out_dir}/config_{mid_fix}_")
+        return metrics
+
+    if pipeline is not None:
+        pipeline.point(mid_fix, config_hash, timer)
+        pipeline.submit(mid_fix, metrics_path, finalize)
+        return None
+    return finalize()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,179 @@
+"""Grid-level execution pipeline: host/device overlap + grid observability.
+
+One in-process grid sweep (``experiments/rq.py``) used to run every point
+strictly sequentially: setup → attack → evaluate → save. The attack is the
+only device-bound stage; evaluation kick-off, ``.npy``/metrics/CSV
+serialization, and event streaming are host work that can run while the
+device executes the *next* point's attack (JAX dispatch is thread-safe and
+async). This module provides that overlap:
+
+- :class:`GridPipeline.submit` hands a point's finalize closure (evaluate +
+  serialize + stream + metrics write) to a single background writer thread.
+  FIFO on one worker gives a strict ordering guarantee: a point's artifacts
+  are written in submission order, and within a point the metrics JSON is
+  written last — so "metrics file exists" still implies "all sibling
+  artifacts exist", which is what ``should_skip``'s config-hash idempotency
+  relies on. Queued-but-unwritten hashes are tracked (:meth:`is_pending`)
+  so a duplicate grid point skips even before its metrics file lands.
+- Writer failures are caught per point (same isolation as a failed attack:
+  logged, sweep continues) and surfaced in the grid report.
+- :meth:`point` records per-point spans/counters; :meth:`finish` drains the
+  writer and assembles the ``grid_report_{hash}.json`` aggregate — points,
+  compile-vs-run span totals, artifact/engine cache hit deltas, and the
+  number of distinct programs traced (the executable-reuse headline: an
+  ε sweep should trace far fewer programs than it has grid points).
+
+MoEvA's mid-run checkpointing is untouched by design: the checkpointer runs
+inside ``Moeva2.generate`` on the launching thread, strictly before the
+point's finalize is submitted, so a crash mid-attack leaves the same
+resumable state as without the pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+
+from ..utils.config import get_dict_hash
+from . import common
+
+logger = logging.getLogger(__name__)
+
+
+class GridPipeline:
+    """Shared execution context for one in-process grid sweep."""
+
+    def __init__(self):
+        self._queue: queue.Queue = queue.Queue()
+        self._pending: set[str] = set()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._submitted = 0
+        self.points: list[dict] = []
+        self.write_failures: list[dict] = []
+        self._t0 = time.time()
+        self._artifacts0 = common.ARTIFACTS.stats()
+        self._engines0 = common.ENGINES.stats()
+
+    # -- background writer ---------------------------------------------------
+    def _worker(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                label, metrics_path, finalize = item
+                try:
+                    finalize()
+                except Exception as e:
+                    logger.exception("grid point finalize failed: %s", label)
+                    self.write_failures.append({"point": label, "error": repr(e)})
+                finally:
+                    with self._lock:
+                        self._pending.discard(metrics_path)
+            finally:
+                self._queue.task_done()
+
+    def submit(self, label: str, metrics_path: str, finalize) -> None:
+        """Queue a point's finalize closure on the writer thread."""
+        with self._lock:
+            self._pending.add(metrics_path)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="grid-writer", daemon=True
+                )
+                self._thread.start()
+        self._submitted += 1
+        self._queue.put((label, metrics_path, finalize))
+
+    def is_pending(self, metrics_path: str) -> bool:
+        with self._lock:
+            return metrics_path in self._pending
+
+    def drain(self) -> None:
+        """Block until every queued finalize has run."""
+        self._queue.join()
+
+    def close(self) -> None:
+        self.drain()
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join()
+            self._thread = None
+
+    # -- observability -------------------------------------------------------
+    def point(self, attack: str, config_hash: str, timer, skipped: bool = False):
+        """Record one launched grid point; ``timer`` is the point's
+        PhaseTimer, read again at :meth:`finish` time so spans added later by
+        the writer thread (evaluate/write) are included."""
+        self.points.append(
+            {
+                "attack": attack,
+                "config_hash": config_hash,
+                "skipped": skipped,
+                "_timer": timer,
+            }
+        )
+
+    @staticmethod
+    def _delta(now: dict, before: dict) -> dict:
+        return {k: now[k] - before.get(k, 0) for k in now}
+
+    def finish(self, grid_config: dict, out_dirs) -> dict:
+        """Drain the writer and write ``grid_report_{hash}.json``."""
+        self.close()
+        points = []
+        for p in self.points:
+            timer = p.pop("_timer", None)
+            if timer is not None:
+                p["spans"] = {k: round(v, 4) for k, v in timer.spans.items()}
+                p["counters"] = dict(timer.counters)
+            points.append(p)
+
+        def span_total(name):
+            return round(
+                sum(p.get("spans", {}).get(name, 0.0) for p in points), 3
+            )
+
+        launched = [p for p in points if not p["skipped"]]
+        report = {
+            "grid_config_hash": get_dict_hash(grid_config),
+            "grid_wallclock_s": round(time.time() - self._t0, 3),
+            "points_total": len(points),
+            "points_launched": len(launched),
+            "points_skipped": len(points) - len(launched),
+            "distinct_compiled_programs": sum(
+                p.get("counters", {}).get("traces", 0) for p in points
+            ),
+            "attack_compile_s": span_total("attack_compile"),
+            "attack_run_s": span_total("attack_run"),
+            "setup_s": span_total("setup"),
+            "evaluate_s": span_total("evaluate"),
+            "write_s": span_total("write"),
+            "artifact_cache": self._delta(
+                common.ARTIFACTS.stats(), self._artifacts0
+            ),
+            "engine_cache": self._delta(common.ENGINES.stats(), self._engines0),
+            "writer": {
+                "submitted": self._submitted,
+                "failures": self.write_failures,
+            },
+            "points": points,
+        }
+        for out_dir in out_dirs:
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+                path = os.path.join(
+                    out_dir, f"grid_report_{report['grid_config_hash']}.json"
+                )
+                with open(path, "w") as f:
+                    json.dump(report, f, indent=1)
+                report["report_path"] = path
+                break
+            except OSError as e:
+                logger.warning("could not write grid report to %s: %s", out_dir, e)
+        return report

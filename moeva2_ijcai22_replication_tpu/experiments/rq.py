@@ -11,6 +11,15 @@ Launch modes: in-process (default — runner functions are called directly,
 sharing one JAX runtime across the grid) or ``use_subprocess=True`` for the
 reference's process-isolation semantics (failed points are logged and the
 grid continues).
+
+In-process grids run through a :class:`..experiments.pipeline.GridPipeline`
+(disable with ``pipeline: false`` in the grid config): artifact loads and
+attack engines are shared across points, ε is a runtime argument of the
+compiled PGD programs, and each point's evaluation/serialization runs on a
+background writer while the device executes the next point's attack. The
+pipeline is drained before :meth:`GridRunner.run` returns and writes a
+``grid_report_{hash}.json`` aggregate (per-point spans, compile-vs-run
+totals, cache hit counters) beside the point results.
 """
 
 from __future__ import annotations
@@ -46,10 +55,20 @@ class GridRunner:
         self.config = config
         self.use_subprocess = use_subprocess
         self.launch_counter = 0
+        self.pipeline = None
+        if not use_subprocess and config.get("pipeline", True):
+            from .pipeline import GridPipeline
+
+            self.pipeline = GridPipeline()
+        self._out_dirs: list[str] = []
+        self.report: dict | None = None
 
     # -- launching ----------------------------------------------------------
     def _launch(self, module: str, cfg: dict) -> None:
         self.launch_counter += 1
+        out_dir = cfg.get("dirs", {}).get("results")
+        if out_dir and out_dir not in self._out_dirs:
+            self._out_dirs.append(out_dir)
         if self.use_subprocess:
             blob = json.dumps(cfg, separators=(",", ":"))
             script = [sys.executable, "-m", module, "-j", blob]
@@ -69,7 +88,7 @@ class GridRunner:
         # logged and the sweep continues (the reference gets this for free
         # from its per-point processes).
         try:
-            runner.run(cfg)
+            runner.run(cfg, pipeline=self.pipeline)
         except Exception:
             logger.exception("grid point failed in-process: %s", module)
 
@@ -111,20 +130,39 @@ class GridRunner:
 
     def run(self) -> int:
         config = self.config
-        for seed in config["seeds"]:
-            logger.info(f"{TABULATOR} Running seed {seed} ...")
-            for project in config["projects"]:
-                logger.info(f"{TABULATOR * 2} Running project {project} ...")
-                for budget in config["budgets"]:
-                    logger.info(f"{TABULATOR * 3} Running budget {budget} ...")
-                    for extra in self._extra_axis():
-                        overrides = [{"seed": seed, "budget": budget}] + extra
-                        if "moeva" in config["attacks"]:
-                            logger.info(f"{TABULATOR * 4} Running MoEvA ...")
-                            self._launch_moeva(project, overrides)
-                        if "pgd" in config["attacks"]:
-                            logger.info(f"{TABULATOR * 4} Running pgd ...")
-                            self._launch_pgd(project, overrides)
+        try:
+            for seed in config["seeds"]:
+                logger.info(f"{TABULATOR} Running seed {seed} ...")
+                for project in config["projects"]:
+                    logger.info(f"{TABULATOR * 2} Running project {project} ...")
+                    for budget in config["budgets"]:
+                        logger.info(f"{TABULATOR * 3} Running budget {budget} ...")
+                        for extra in self._extra_axis():
+                            overrides = [{"seed": seed, "budget": budget}] + extra
+                            if "moeva" in config["attacks"]:
+                                logger.info(f"{TABULATOR * 4} Running MoEvA ...")
+                                self._launch_moeva(project, overrides)
+                            if "pgd" in config["attacks"]:
+                                logger.info(f"{TABULATOR * 4} Running pgd ...")
+                                self._launch_pgd(project, overrides)
+        finally:
+            if self.pipeline is not None:
+                # drain the background writer (every queued point lands on
+                # disk before the grid returns) and publish the aggregate
+                self.report = self.pipeline.finish(config, self._out_dirs)
+                logger.info(
+                    "grid report: %d points (%d launched), %d compiled "
+                    "programs, compile %.1fs / run %.1fs, artifact cache "
+                    "%s, engine cache %s -> %s",
+                    self.report["points_total"],
+                    self.report["points_launched"],
+                    self.report["distinct_compiled_programs"],
+                    self.report["attack_compile_s"],
+                    self.report["attack_run_s"],
+                    self.report["artifact_cache"],
+                    self.report["engine_cache"],
+                    self.report.get("report_path", "<unwritten>"),
+                )
         return self.launch_counter
 
 

@@ -14,7 +14,7 @@ import logging
 import sys
 
 from ..utils.config import load_config_file
-from . import moeva, rq
+from . import common, moeva, rq
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +45,13 @@ def run(config_dir: str = "./config") -> None:
     for grid in SM1_GRIDS:
         logger.info("=== grid %s", grid)
         rq.run(load_config_file(f"{config_dir}/{grid}"))
+    # the artifact/engine caches are process-wide, so the whole suite shares
+    # loads and executables ACROSS grids too — surface the aggregate once
+    logger.info(
+        "suite caches: artifacts %s, engines %s",
+        common.ARTIFACTS.stats(),
+        common.ENGINES.stats(),
+    )
 
 
 if __name__ == "__main__":

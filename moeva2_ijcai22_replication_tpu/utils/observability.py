@@ -1,11 +1,21 @@
-"""Per-phase timers and on-demand profiler traces.
+"""Per-phase timers, cache/trace counters, and on-demand profiler traces.
 
 The reference's observability is wall-clock spans written into
 ``metrics_*.json`` plus optional Comet/TensorBoard streams
 (``04_moeva.py:70,89``, ``src/utils/comet.py``, SURVEY.md §5). TPU
-equivalent: a :class:`PhaseTimer` collecting named spans that runners embed
-in the same metrics JSON (compile vs run vs eval visible separately), and a
+equivalent: a :class:`PhaseTimer` collecting named spans *and* integer
+counters that runners embed in the same metrics JSON (compile vs run vs
+eval visible separately, cache hits attributable per point), and a
 ``jax.profiler`` trace context toggled by config — no external service.
+
+Compile-vs-run attribution: attack engines count program (re)traces
+(``engine.trace_count`` — their jitted python bodies run exactly once per
+trace), so a runner wraps the attack dispatch in :func:`PhaseTimer.attack`
+and the span lands in ``attack_compile`` when the call traced (its wall
+clock includes tracing + XLA compilation or a persistent-cache load) and in
+``attack_run`` when it re-used an executable. The grid report sums these
+across points, which is what makes executable reuse visible: a healthy
+ε-sweep shows one ``attack_compile`` span and N-1 ``attack_run`` spans.
 """
 
 from __future__ import annotations
@@ -15,10 +25,12 @@ import time
 
 
 class PhaseTimer:
-    """Named wall-clock spans; ``.spans`` is JSON-ready."""
+    """Named wall-clock spans + counters; ``.spans``/``.counters`` are
+    JSON-ready."""
 
     def __init__(self):
         self.spans: dict[str, float] = {}
+        self.counters: dict[str, int] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -26,7 +38,30 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.spans[name] = self.spans.get(name, 0.0) + time.time() - t0
+            self.add(name, time.time() - t0)
+
+    def add(self, name: str, seconds: float):
+        self.spans[name] = self.spans.get(name, 0.0) + seconds
+
+    def count(self, name: str, n: int = 1):
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    @contextlib.contextmanager
+    def attack(self, engine, name: str = "attack"):
+        """Time an attack dispatch, splitting the span into
+        ``{name}_compile`` / ``{name}_run`` by whether ``engine`` traced a
+        new program during the call, and counting the traces."""
+        traces0 = getattr(engine, "trace_count", 0)
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            dt = time.time() - t0
+            traced = getattr(engine, "trace_count", 0) - traces0
+            self.add(name, dt)
+            self.add(f"{name}_compile" if traced else f"{name}_run", dt)
+            if traced:
+                self.count("traces", traced)
 
 
 @contextlib.contextmanager

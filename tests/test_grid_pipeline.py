@@ -1,0 +1,323 @@
+"""Grid execution pipeline: executable reuse, artifact caching, writer.
+
+Covers the three layers of the grid pipeline (docs/DESIGN.md §"Grid
+execution pipeline") without reference data: ε as a runtime argument of the
+compiled PGD/AutoPGD programs (bit-identical to baked-in ε, one trace per
+static config across an ε sweep), the mtime-keyed artifact cache, the
+background writer's ordering/isolation/pending-hash guarantees, and the
+engine's mesh-multiple chunk rounding.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.pgd import AutoPGD, ConstrainedPGD
+from moeva2_ijcai22_replication_tpu.core.constraints import FunctionalConstraintSet
+from moeva2_ijcai22_replication_tpu.core.schema import FeatureSchema
+from moeva2_ijcai22_replication_tpu.experiments import common
+from moeva2_ijcai22_replication_tpu.experiments.common import ArtifactCache
+from moeva2_ijcai22_replication_tpu.experiments.pipeline import GridPipeline
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import MLP, init_params
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+from moeva2_ijcai22_replication_tpu.utils.observability import PhaseTimer
+
+
+def _schema(n=6):
+    return FeatureSchema(
+        names=tuple(f"f{i}" for i in range(n)),
+        types=np.array(["real"] * n, dtype=object),
+        mutable=np.ones(n, dtype=bool),
+        raw_min=np.array([0.0] * n, dtype=object),
+        raw_max=np.array([1.0] * n, dtype=object),
+        augmentation=np.zeros(n, dtype=bool),
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    schema = _schema()
+    cons = FunctionalConstraintSet(
+        schema,
+        fn=lambda x: jnp.stack(
+            [x[..., 0] - x[..., 1], jnp.abs(x[..., 2] - 0.5) - 0.4], axis=-1
+        ),
+        n_constraints=2,
+    )
+    model = MLP(hidden=(8,), n_classes=2)
+    sur = Surrogate(model, init_params(model, schema.n_features, seed=0))
+    scaler = fit_minmax(np.zeros(6), np.ones(6))
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 6)).astype(np.float32)
+    y = np.zeros(16, dtype=np.int64)
+    return cons, sur, scaler, x, y
+
+
+def _pgd(setup, cls=ConstrainedPGD, **over):
+    cons, sur, scaler, x, y = setup
+    kw = dict(
+        classifier=sur, constraints=cons, scaler=scaler,
+        eps=0.3, eps_step=0.05, max_iter=8, norm=2,
+        loss_evaluation="constraints+flip", seed=7,
+    )
+    kw.update(over)
+    return cls(**kw)
+
+
+class TestEpsRuntimeArgument:
+    def test_runtime_eps_matches_baked_in_eps(self, setup):
+        """An engine constructed with ε=A (the pre-pipeline 'baked-in'
+        configuration) and an engine constructed with a different ε but
+        dispatched with generate(eps=A) must produce bit-identical output."""
+        cons, sur, scaler, x, y = setup
+        baked = _pgd(setup, eps=0.2, eps_step=0.05)
+        out_baked = baked.generate(x, y)
+        swept = _pgd(setup, eps=0.9, eps_step=0.4)  # deliberately wrong defaults
+        out_swept = swept.generate(x, y, eps=0.2, eps_step=0.05)
+        np.testing.assert_array_equal(out_baked, out_swept)
+
+    def test_runtime_eps_matches_baked_in_autopgd(self, setup):
+        baked = _pgd(setup, cls=AutoPGD, eps=0.2, eps_step=0.2 / 3,
+                     num_random_init=1)
+        out_baked = baked.generate(x_scaled := setup[3], setup[4])
+        swept = _pgd(setup, cls=AutoPGD, eps=0.7, eps_step=0.1,
+                     num_random_init=1)
+        out_swept = swept.generate(x_scaled, setup[4], eps=0.2, eps_step=0.2 / 3)
+        np.testing.assert_array_equal(out_baked, out_swept)
+
+    def test_adaptive_step_uses_runtime_eps(self, setup):
+        atk = _pgd(
+            setup, eps=0.5,
+            loss_evaluation="constraints+flip+adaptive_eps_step",
+        )
+        a = atk.generate(setup[3], setup[4], eps=0.1)
+        b = atk.generate(setup[3], setup[4], eps=0.3)
+        assert not np.array_equal(a, b)  # ε actually reaches the program
+
+    def test_one_compile_serves_multi_eps_sweep(self, setup):
+        """The executable-reuse contract: a fixed-loss multi-ε sweep traces
+        (and therefore compiles) exactly one program."""
+        atk = _pgd(setup)
+        outs = [atk.generate(setup[3], setup[4], eps=e) for e in (0.1, 0.2, 0.3)]
+        assert atk.trace_count == 1
+        assert not np.array_equal(outs[0], outs[2])  # sweep is real
+
+    def test_restart_path_single_trace(self, setup):
+        atk = _pgd(setup, num_random_init=2)
+        for e in (0.1, 0.25):
+            atk.generate(setup[3], setup[4], eps=e)
+        assert atk.trace_count == 1
+
+
+class TestBudgetRuntimeArgument:
+    def test_runtime_budget_matches_baked_in_budget(self, setup):
+        """Plain PGD without history takes the budget as a dynamic fori_loop
+        trip count: one engine swept over budgets must match per-budget baked
+        engines bit-for-bit, with a single trace."""
+        eng = _pgd(setup, loss_evaluation="constraints+flip+adaptive_eps_step")
+        a8 = eng.generate(setup[3], setup[4], eps=0.2, max_iter=8)
+        a20 = eng.generate(setup[3], setup[4], eps=0.2, max_iter=20)
+        assert eng.trace_count == 1
+        for budget, out in ((8, a8), (20, a20)):
+            baked = _pgd(
+                setup, eps=0.2, max_iter=budget,
+                loss_evaluation="constraints+flip+adaptive_eps_step",
+            )
+            np.testing.assert_array_equal(baked.generate(setup[3], setup[4]), out)
+
+    def test_history_program_bakes_budget(self, setup):
+        """History recording shapes buffers by max_iter at trace time, so the
+        budget must stay static: a mismatched runtime budget is rejected, and
+        the recorded history keeps the (N, max_iter, C) contract. (The x
+        output is compared with tolerance only — recording adds buffer writes
+        to the compiled body, which may legally fuse differently from the
+        recording-free program.)"""
+        rec = _pgd(setup, eps=0.2, record_loss="reduced")
+        assert rec._runtime_max_iter() is False
+        out = rec.generate(setup[3], setup[4])
+        assert rec.loss_history.shape == (16, 8, 3)
+        dyn = _pgd(setup)
+        np.testing.assert_allclose(
+            dyn.generate(setup[3], setup[4], eps=0.2, max_iter=8), out,
+            rtol=2e-4, atol=2e-4,
+        )
+        with pytest.raises(ValueError, match="trace-static budget"):
+            rec.generate(setup[3], setup[4], max_iter=9)
+
+
+class TestEngineCache:
+    def test_hit_and_miss_counters(self):
+        cache = common.EngineCache()
+        built = []
+        e1 = cache.get(("a", 1), lambda: built.append(1) or object())
+        e2 = cache.get(("a", 1), lambda: built.append(2) or object())
+        e3 = cache.get(("a", 2), lambda: built.append(3) or object())
+        assert e1 is e2 and e1 is not e3
+        assert built == [1, 3]
+        assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 2
+
+
+class TestArtifactCache:
+    def test_same_object_across_lookups(self, tmp_path):
+        path = tmp_path / "x.npy"
+        np.save(path, np.arange(6.0))
+        cache = ArtifactCache()
+        a = cache.get("candidates", [str(path)], None, lambda: np.load(path))
+        b = cache.get("candidates", [str(path)], None, lambda: np.load(path))
+        assert a is b
+        assert cache.stats() == {"hits": 1, "misses": 1, "entries": 1}
+
+    def test_invalidates_on_mtime_change(self, tmp_path):
+        path = tmp_path / "x.npy"
+        np.save(path, np.arange(6.0))
+        cache = ArtifactCache()
+        a = cache.get("candidates", [str(path)], None, lambda: np.load(path))
+        np.save(path, np.arange(6.0) + 1)  # rewrite: new mtime_ns
+        os.utime(path, ns=(time.time_ns(), time.time_ns() + 1))
+        b = cache.get("candidates", [str(path)], None, lambda: np.load(path))
+        assert a is not b
+        np.testing.assert_array_equal(b, np.arange(6.0) + 1)
+        assert cache.stats()["misses"] == 2
+
+    def test_extra_key_separates_entries(self, tmp_path):
+        path = tmp_path / "x.npy"
+        np.save(path, np.arange(4.0))
+        cache = ArtifactCache()
+        a = cache.get("k", [str(path)], "lcld", lambda: ["a"])
+        b = cache.get("k", [str(path)], "botnet", lambda: ["b"])
+        assert a == ["a"] and b == ["b"]
+
+    def test_load_candidates_shares_the_disk_read(self, tmp_path):
+        """Runner-facing path: grid points slicing the same candidate file
+        share one np.load; -1 returns the identical cached object."""
+        path = tmp_path / "cand.npy"
+        np.save(path, np.arange(20.0).reshape(10, 2))
+        cfg = {
+            "paths": {"x_candidates": str(path)},
+            "initial_state_offset": 0,
+            "n_initial_state": -1,
+        }
+        misses0 = common.ARTIFACTS.misses
+        a = common.load_candidates(cfg)
+        b = common.load_candidates(cfg)
+        assert a is b
+        c = common.load_candidates({**cfg, "n_initial_state": 4})
+        assert c.shape == (4, 2) and c.base is a
+        assert common.ARTIFACTS.misses == misses0 + 1
+
+
+class TestBackgroundWriter:
+    def test_fifo_ordering_and_drain(self):
+        pipe = GridPipeline()
+        done = []
+        for i in range(8):
+            pipe.submit(f"p{i}", f"/tmp/metrics_{i}", lambda i=i: done.append(i))
+        pipe.drain()
+        assert done == list(range(8))  # strict submission order
+        pipe.close()
+
+    def test_pending_until_written(self):
+        pipe = GridPipeline()
+        gate = threading.Event()
+        pipe.submit("p", "/x/metrics.json", gate.wait)
+        assert pipe.is_pending("/x/metrics.json")
+        gate.set()
+        pipe.drain()
+        assert not pipe.is_pending("/x/metrics.json")
+        pipe.close()
+
+    def test_failure_is_isolated_and_reported(self, tmp_path):
+        pipe = GridPipeline()
+        done = []
+
+        def boom():
+            raise RuntimeError("disk on fire")
+
+        pipe.submit("bad", "/x/a", boom)
+        pipe.submit("good", "/x/b", lambda: done.append("ok"))
+        report = pipe.finish({"grid": 1}, [str(tmp_path)])
+        assert done == ["ok"]  # the failure did not kill the writer
+        assert report["writer"]["failures"][0]["point"] == "bad"
+        assert not pipe.is_pending("/x/a")  # failed writes clear pending too
+
+    def test_should_skip_sees_queued_hashes(self, tmp_path):
+        """Config-hash idempotency must hold while the metrics write is
+        still queued: a duplicate point skips before the file lands."""
+        pipe = GridPipeline()
+        cfg = {
+            "dirs": {"results": str(tmp_path)},
+            "attack_name": "moeva",
+        }
+        path = common.metrics_path_for(cfg, "moeva")
+        gate = threading.Event()
+        pipe.submit("moeva", path, gate.wait)
+        assert common.should_skip(cfg, "moeva", pipe)
+        assert not common.should_skip(cfg, "moeva", None)  # file not yet there
+        gate.set()
+        pipe.close()
+
+    def test_grid_report_contents(self, tmp_path):
+        pipe = GridPipeline()
+        timer = PhaseTimer()
+        timer.add("attack_compile", 1.5)
+        timer.add("attack_run", 0.5)
+        timer.count("traces", 1)
+        pipe.point("pgd_flip", "abc", timer)
+        pipe.point("pgd_flip", "def", None, skipped=True)
+        pipe.submit("pgd_flip", "/x/m", lambda: None)
+        report = pipe.finish({"seeds": [42]}, [str(tmp_path)])
+        assert report["points_total"] == 2
+        assert report["points_launched"] == 1
+        assert report["points_skipped"] == 1
+        assert report["distinct_compiled_programs"] == 1
+        assert report["attack_compile_s"] == pytest.approx(1.5)
+        assert report["attack_run_s"] == pytest.approx(0.5)
+        assert os.path.exists(report["report_path"])
+        assert os.path.basename(report["report_path"]) == (
+            f"grid_report_{report['grid_config_hash']}.json"
+        )
+
+
+class TestPhaseTimerAttack:
+    def test_compile_vs_run_attribution(self):
+        class FakeEngine:
+            trace_count = 0
+
+        eng = FakeEngine()
+        timer = PhaseTimer()
+        with timer.attack(eng):
+            eng.trace_count += 1  # first dispatch traces
+        with timer.attack(eng):
+            pass  # steady dispatch
+        assert timer.counters["traces"] == 1
+        assert set(timer.spans) == {"attack", "attack_compile", "attack_run"}
+        assert timer.spans["attack"] == pytest.approx(
+            timer.spans["attack_compile"] + timer.spans["attack_run"]
+        )
+
+
+class TestChunkMeshRounding:
+    def test_chunk_rounds_down_to_mesh_multiple(self, setup):
+        """config/moeva.yaml satellite: a chunk that is not a mesh-size
+        multiple is rounded down in the engine (floor at one mesh row)
+        instead of raising."""
+        from jax.sharding import Mesh
+
+        cons, sur, scaler, x, y = setup
+        mesh = Mesh(np.array(jax.devices()[:8]), ("states",))
+        moeva = Moeva2(
+            classifier=sur, constraints=cons, ml_scaler=scaler,
+            norm=2, n_gen=3, n_pop=8, n_offsprings=4, seed=3,
+            max_states_per_call=6,  # not a multiple of 8 -> rounds to 8
+            mesh=mesh,
+        )
+        res = moeva.generate(x, 1)  # 16 states: two 8-state chunks
+        assert res.x_ml.shape[0] == 16
+        assert np.isfinite(res.f).all()

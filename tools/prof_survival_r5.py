@@ -23,6 +23,7 @@ N_GEN = int(os.environ.get("P_GENS", 60))
 from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
 from moeva2_ijcai22_replication_tpu.attacks.moeva.survival import (
     NormState,
+    _niche_gumbels,
     _survive_post,
     _survive_pre,
     associate_batch,
@@ -102,14 +103,17 @@ def rng_body(ks, ff, sst):
 
 
 def post_body(ks, ff, sst):
-    # fixed niche/dist/ranks: isolates _survive_post
+    # fixed niche/dist/ranks: isolates _survive_post (its random fields come
+    # from the batched bulk gumbel draws, as in the production survive_batch)
     niche = jnp.zeros((s, m), jnp.int32)
     dist = ff[..., 0]
     ranks = jnp.asarray(rng.integers(0, 4, (s, m)), jnp.int32)
-    keys = jax.random.split(ks, s)
+    gum_cut, gum_mem = _niche_gumbels(ks, (s,), 106, m)
     mask = jax.vmap(
-        lambda k, f1, r1, ni, di: _survive_post(k, f1, r1, ni, di, 106, pop_size)
-    )(keys, ff, ranks, niche, dist)
+        lambda gc, gm, f1, r1, ni, di: _survive_post(
+            gc, gm, f1, r1, ni, di, 106, pop_size
+        )
+    )(gum_cut, gum_mem, ff, ranks, niche, dist)
     return mask.sum(), sst
 
 

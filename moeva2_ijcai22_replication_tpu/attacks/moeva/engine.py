@@ -352,6 +352,18 @@ class Moeva2:
         return segment
 
     # -- public API ---------------------------------------------------------
+    def effective_states_chunk(self) -> int | None:
+        """The states-chunk size :meth:`generate` actually dispatches with:
+        ``max_states_per_call`` rounded DOWN to a mesh-size multiple (never
+        up — the configured chunk is a device-memory / program-size ceiling),
+        e.g. the 500 default on an 8-device mesh runs as 496. Chunking folds
+        per-chunk RNG keys, so runners record this value in the metrics to
+        keep every committed number's execution mode traceable."""
+        chunk = self.max_states_per_call
+        if chunk and self.mesh is not None and chunk % self.mesh.size:
+            chunk = max(chunk - chunk % self.mesh.size, self.mesh.size)
+        return chunk
+
     def generate(self, x: np.ndarray, minimize_class=1) -> MoevaResult:
         """Attack every row of ``x`` (parity: ``Moeva2.generate``,
         ``moeva2.py:174-207`` — but batched on device instead of forked)."""
@@ -369,12 +381,7 @@ class Moeva2:
         if minimize_class.shape[0] != s:
             raise ValueError("minimize_class must be scalar or length n_states")
 
-        chunk = self.max_states_per_call
-        if chunk and self.mesh is not None and chunk % self.mesh.size:
-            # round down to a mesh-size multiple (never up: the configured
-            # chunk is a device-memory / program-size ceiling) instead of
-            # erroring — e.g. the 500 default on an 8-device mesh runs as 496
-            chunk = max(chunk - chunk % self.mesh.size, self.mesh.size)
+        chunk = self.effective_states_chunk()
         if chunk and s > chunk:
             return self._generate_chunked(x, minimize_class, chunk)
         return self._generate_one(

@@ -13,6 +13,23 @@ from __future__ import annotations
 import jax
 
 
+def describe_mesh(mesh) -> dict | None:
+    """JSON-ready mesh identity for metrics files and serving responses.
+
+    The mesh shape is part of a run's RNG-affecting execution mode (padding
+    to mesh multiples changes batch shapes, and MoEvA's chunk keys fold per
+    chunk), so every committed number carries it: ``None`` for single-device
+    runs, else ``{"devices", "shape", "axes"}``.
+    """
+    if mesh is None:
+        return None
+    return {
+        "devices": int(mesh.size),
+        "shape": [int(s) for s in mesh.devices.shape],
+        "axes": [str(a) for a in mesh.axis_names],
+    }
+
+
 def shard_states_args(mesh, states_axis: str, replicated: tuple, sharded: tuple):
     """Place arrays for a states-sharded attack dispatch.
 

@@ -30,6 +30,66 @@ def _months(f: np.ndarray) -> np.ndarray:
     return np.floor(f / 100) * 12 + f % 100
 
 
+def synth_lcld_schema(out_dir: str) -> dict:
+    """Write a self-contained LCLD schema pair (``features.csv`` +
+    ``constraints.csv``) and return their paths.
+
+    The reference's schema files are not redistributed; this one is derived
+    entirely from committed code — indices 0..25 are the named numeric
+    features of ``domains/lcld.py``'s constraint kernel, 26..46 three
+    one-hot groups (4+14+3), bounds covering :func:`synth_lcld`'s generator
+    ranges. It makes dataset-free consumers (the serving bench/tests)
+    runnable anywhere; committed experiment numbers keep using the
+    reference schema.
+    """
+    import os
+
+    rows = [
+        ("loan_amnt", "real", "TRUE", 1000, 40000),
+        ("term", "int", "TRUE", 36, 60),
+        ("int_rate", "real", "TRUE", 5.31, 30.99),
+        ("installment", "real", "TRUE", 0, 3500),
+        ("grade", "int", "TRUE", 0, 7),
+        ("emp_length", "int", "TRUE", 0, 10),
+        ("annual_inc", "real", "TRUE", 10000, 300000),
+        ("issue_d", "int", "FALSE", 201203, 201812),
+        ("dti", "real", "TRUE", 0, 45),
+        ("earliest_cr_line", "int", "FALSE", 198001, 201812),
+        ("open_acc", "int", "TRUE", 0, 80),
+        ("pub_rec", "int", "TRUE", 0, 10),
+        ("revol_bal", "real", "TRUE", 0, 100000),
+        ("revol_util", "real", "TRUE", 0, 150),
+        ("total_acc", "int", "TRUE", 0, 80),
+        ("mort_acc", "int", "TRUE", 0, 10),
+        ("pub_rec_bankruptcies", "int", "TRUE", 0, 10),
+        ("fico_score", "real", "TRUE", 600, 850),
+        ("initial_list_status_w", "int", "TRUE", 0, 1),
+        ("application_type_joint", "int", "TRUE", 0, 1),
+        ("ratio_loan_income", "real", "TRUE", 0, 4),
+        ("ratio_open_total", "real", "TRUE", 0, 1),
+        ("month_since_cr_line", "real", "TRUE", 0, 400),
+        ("ratio_pubrec_month", "real", "TRUE", 0, 1),
+        ("ratio_bankrupt_month", "real", "TRUE", 0, 1),
+        ("ratio_bankrupt_pubrec", "real", "TRUE", -1, 1),
+    ]
+    for g, k in (("ohe0", 4), ("ohe1", 14), ("ohe2", 3)):
+        for j in range(k):
+            rows.append((f"{g}_{j}", g, "TRUE", 0, 1))
+    assert len(rows) == 47
+    os.makedirs(out_dir, exist_ok=True)
+    features = os.path.join(out_dir, "features.csv")
+    with open(features, "w") as f:
+        f.write("feature,type,mutable,min,max,augmentation\n")
+        for name, t, mut, lo, hi in rows:
+            f.write(f"{name},{t},{mut},{lo},{hi},FALSE\n")
+    constraints = os.path.join(out_dir, "constraints.csv")
+    with open(constraints, "w") as f:
+        f.write("constraint,min,max\n")
+        for i in range(10):
+            f.write(f"g{i + 1},0,1\n")
+    return {"features": features, "constraints": constraints}
+
+
 def synth_lcld(
     n: int, schema: FeatureSchema, seed: int = 0, label_rate: float = 0.5
 ) -> np.ndarray:

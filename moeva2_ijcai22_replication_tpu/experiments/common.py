@@ -12,6 +12,7 @@ grid and shares compiled executables instead of rebuilding per point.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -29,10 +30,17 @@ class ArtifactCache:
     An entry is valid while every file it was built from keeps its
     (mtime_ns, size) stamp; a touched or rewritten file invalidates exactly
     that entry on the next lookup. Hit/miss counters feed the grid report.
+
+    Lookups are serialized: the grid pipeline's background writer (point A's
+    evaluation) and the launching thread (point B's setup) — and serving
+    resolves — hit this process-wide cache concurrently, and a racing miss
+    must not build twice (a replaced constraints object would change a later
+    ``id()``-keyed engine-cache key and force a spurious recompile).
     """
 
     def __init__(self):
         self._entries: dict = {}
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
@@ -49,14 +57,15 @@ class ArtifactCache:
         paths = tuple(os.path.abspath(p) for p in paths)
         key = (kind, paths, extra)
         stamp = self._stamp(paths)
-        entry = self._entries.get(key)
-        if entry is not None and entry[0] == stamp:
-            self.hits += 1
-            return entry[1]
-        self.misses += 1
-        value = builder()
-        self._entries[key] = (stamp, value)
-        return value
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry[0] == stamp:
+                self.hits += 1
+                return entry[1]
+            self.misses += 1
+            value = builder()
+            self._entries[key] = (stamp, value)
+            return value
 
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses, "entries": len(self._entries)}
@@ -84,18 +93,23 @@ class EngineCache:
 
     def __init__(self):
         self._engines: dict = {}
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def get(self, key: tuple, builder):
-        engine = self._engines.get(key)
-        if engine is not None:
-            self.hits += 1
+        # serialized like ArtifactCache.get: a racing miss must not build
+        # two engine instances for one key (each would trace its own
+        # executables — exactly the duplication this cache exists to prevent)
+        with self._lock:
+            engine = self._engines.get(key)
+            if engine is not None:
+                self.hits += 1
+                return engine
+            self.misses += 1
+            engine = builder()
+            self._engines[key] = engine
             return engine
-        self.misses += 1
-        engine = builder()
-        self._engines[key] = engine
-        return engine
 
     def stats(self) -> dict:
         return {
@@ -254,15 +268,34 @@ def build_mesh(config: dict):
     return Mesh(np.array(devices), ("states",))
 
 
-def pad_states(x: np.ndarray, mesh) -> tuple[np.ndarray, int]:
+def pad_states(
+    x: np.ndarray, mesh, bucket: int | None = None
+) -> tuple[np.ndarray, int]:
     """Pad the leading (states) axis to a mesh-size multiple.
 
     Candidate counts are data-dependent (e.g. the 387-row botnet set), so
     runners pad with copies of the last row before a mesh-sharded attack and
     trim every per-state result back to ``n_orig`` rows afterwards. Returns
     ``(x_padded, n_orig)``; a no-op without a mesh or when already aligned.
+
+    With ``bucket``, pads to exactly ``bucket`` rows instead of the nearest
+    mesh multiple — the serving microbatcher's fixed-shape dispatch mode
+    (one compiled program per bucket size). ``bucket`` must be >= the row
+    count and itself a mesh multiple, so the two contracts compose.
     """
     n = x.shape[0]
+    if bucket is not None:
+        if bucket < n:
+            raise ValueError(f"bucket={bucket} smaller than n_states={n}")
+        if mesh is not None and bucket % mesh.size != 0:
+            raise ValueError(
+                f"bucket={bucket} must be a multiple of the mesh size "
+                f"{mesh.size} (serving bucket menus must be mesh-aligned)"
+            )
+        if bucket == n:
+            return x, n
+        pad = bucket - n
+        return np.concatenate([x, np.repeat(x[-1:], pad, axis=0)]), n
     if mesh is None or n % mesh.size == 0:
         return x, n
     pad = (-n) % mesh.size

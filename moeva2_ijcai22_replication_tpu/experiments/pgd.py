@@ -27,6 +27,7 @@ import numpy as np
 from ..attacks.objective import ObjectiveCalculator
 from ..attacks.pgd import AutoPGD, ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sat import SatAttack
+from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file
@@ -226,6 +227,15 @@ def run(config: dict, pipeline=None):
         metrics = {
             "objectives": objectives,
             "time": consumed_time,
+            # the reference-schema "time" field spans the whole attack call;
+            # on a cold engine that includes trace + XLA compile (or a
+            # persistent-cache load), so the flag travels with the number
+            "includes_compile": "attack_compile" in timer.spans,
+            # RNG-affecting execution mode of this number (VERDICT r5 item 8)
+            "execution": {
+                "max_states_per_call": None,  # PGD dispatches one batch
+                "mesh": describe_mesh(attack.mesh),
+            },
             "timings": timer.spans,
             "counters": timer.counters,
             "config": config,

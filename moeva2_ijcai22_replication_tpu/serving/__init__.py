@@ -1,0 +1,36 @@
+"""Attack-as-a-service: a microbatching serving layer over the cached engines.
+
+The batch experiment path (``experiments/``) amortises compiles across grid
+points; this package amortises them across *concurrent requests*: an
+in-process :class:`AttackService` accepts :class:`AttackRequest` rows,
+resolves them to the same process-wide engine/artifact caches the grid
+runners use (``experiments.common.ENGINES`` / ``ARTIFACTS``), and executes
+them through a shape-bucketed :class:`Microbatcher` — full fixed-shape
+device batches from variably-sized requests, one compiled program per
+(engine-static-config, bucket-size). ``serving.server`` is the stdlib-only
+JSON/HTTP front; ``serving.sweep`` is the offered-load harness behind
+``bench.py --serving``.
+"""
+
+from .batcher import (
+    BatchExecutionError,
+    BucketMenu,
+    DeadlineExceeded,
+    Microbatcher,
+    QueueFull,
+    RequestTooLarge,
+)
+from .service import AttackRequest, AttackResponse, AttackService, InvalidRequest
+
+__all__ = [
+    "AttackRequest",
+    "AttackResponse",
+    "AttackService",
+    "BatchExecutionError",
+    "BucketMenu",
+    "DeadlineExceeded",
+    "InvalidRequest",
+    "Microbatcher",
+    "QueueFull",
+    "RequestTooLarge",
+]

@@ -1,0 +1,388 @@
+"""Shape-bucketed microbatcher: concurrent requests -> fixed-shape batches.
+
+The continuous-batching pattern every inference stack relies on, specialised
+to attack engines: a jitted program compiles per input *shape*, so serving
+arbitrary request sizes naively would compile per request. Instead requests
+queue FIFO per batch key (everything that must be identical within one
+device dispatch: engine static config + runtime ε/ε-step/budget), a flusher
+coalesces each key's queue up to a deadline (``max_delay_s``) or capacity
+(a full largest bucket), pads the concatenated states axis to a small fixed
+menu of bucket sizes (:class:`BucketMenu` — power-of-two, mesh-size
+multiples, via ``experiments.common.pad_states``), dispatches ONE program
+per bucket, and scatters per-request row slices back.
+
+Semantics the service builds on:
+
+- **FIFO fairness within a key**: assembly never reorders or skips past a
+  queued request — if the head doesn't fit the remaining capacity, the
+  batch closes and the head leads the next one.
+- **Backpressure**: total queued rows are bounded; ``submit`` raises
+  :class:`QueueFull` (with a retry-after hint) instead of queueing
+  unboundedly.
+- **Deadlines**: a request whose absolute deadline passed while queued is
+  cancelled at assembly time, *before* dispatch, with
+  :class:`DeadlineExceeded` — it never consumes device time.
+- **Failure isolation**: one poisoned request fails its batch — every
+  batch-mate's future gets :class:`BatchExecutionError` naming the cause —
+  and the flusher moves on to the next batch; the service never dies with
+  a request.
+
+The clock is injectable and ``start=False`` skips the flusher thread so
+tests drive :meth:`Microbatcher.flush_due` synchronously under a fake
+clock.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from ..experiments.common import pad_states
+
+
+class QueueFull(Exception):
+    """Backpressure: the bounded request queue is full; retry later."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.05):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class RequestTooLarge(ValueError):
+    """A single request exceeds the largest bucket; it can never dispatch."""
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed while it was queued (never dispatched)."""
+
+
+class BatchExecutionError(Exception):
+    """The batch this request was coalesced into failed to execute."""
+
+    def __init__(self, key, cause: BaseException):
+        super().__init__(f"batch for key {key!r} failed: {cause!r}")
+        self.key = key
+        self.cause = cause
+
+
+class BucketMenu:
+    """The fixed menu of allowed batch shapes.
+
+    Small and power-of-two so the compile surface stays bounded (one
+    program per size actually used) while padding waste stays < 2x; every
+    size must be a mesh-size multiple so bucketed batches satisfy the
+    states-axis divisibility contract (``attacks/sharding.py``) without
+    re-padding.
+    """
+
+    def __init__(self, sizes=(8, 16, 32, 64, 128, 256), mesh_size: int = 1):
+        sizes = sorted(int(s) for s in sizes)
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"bucket menu must be non-empty positive: {sizes}")
+        if len(set(sizes)) != len(sizes):
+            raise ValueError(f"bucket menu has duplicates: {sizes}")
+        bad = [s for s in sizes if s % mesh_size]
+        if bad:
+            raise ValueError(
+                f"bucket sizes {bad} are not multiples of the mesh size "
+                f"{mesh_size}; the states-axis sharding contract requires "
+                "mesh-aligned batch shapes"
+            )
+        self.sizes = tuple(sizes)
+        self.max_size = sizes[-1]
+
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest menu size that fits ``n_rows``."""
+        for s in self.sizes:
+            if n_rows <= s:
+                return s
+        raise RequestTooLarge(
+            f"{n_rows} rows exceed the largest bucket {self.max_size}"
+        )
+
+
+@dataclass
+class _Pending:
+    rows: np.ndarray
+    n: int
+    future: Future
+    enqueued_at: float
+    deadline_at: float | None
+    meta: dict
+
+
+@dataclass
+class _KeyQueue:
+    dispatch: Callable[[np.ndarray], np.ndarray]
+    requests: collections.deque = field(default_factory=collections.deque)
+    rows_queued: int = 0
+
+
+class Microbatcher:
+    """Per-key FIFO queues + deadline/capacity flusher + bucketed dispatch."""
+
+    def __init__(
+        self,
+        menu: BucketMenu,
+        *,
+        max_delay_s: float = 0.010,
+        max_queue_rows: int = 4096,
+        metrics=None,
+        clock: Callable[[], float] | None = None,
+        start: bool = True,
+    ):
+        import time
+
+        self.menu = menu
+        self.max_delay_s = float(max_delay_s)
+        self.max_queue_rows = int(max_queue_rows)
+        self.metrics = metrics
+        self.clock = clock or time.monotonic
+        self._queues: dict[Any, _KeyQueue] = {}
+        self._rows_total = 0
+        self._batch_seq = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # engines are single-dispatch objects (host-side knobs are mutated
+        # per batch); one batch executes at a time even when a drain on the
+        # caller thread overlaps the flusher thread
+        self._dispatch_lock = threading.Lock()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, name="microbatch-flusher", daemon=True
+            )
+            self._thread.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(
+        self,
+        key,
+        dispatch: Callable[[np.ndarray], np.ndarray],
+        rows: np.ndarray,
+        *,
+        deadline_s: float | None = None,
+        meta: dict | None = None,
+    ) -> Future:
+        """Queue ``rows`` under ``key``; resolves to ``(result_rows, meta)``.
+
+        ``dispatch`` is the key's batch function (first submit wins; all
+        requests under one key must share it — the service guarantees this
+        by deriving the key from everything the closure captures).
+        """
+        rows = np.asarray(rows)
+        n = rows.shape[0]
+        if n < 1:
+            raise ValueError("empty request (0 rows)")
+        if n > self.menu.max_size:
+            raise RequestTooLarge(
+                f"{n} rows exceed the largest bucket {self.menu.max_size}; "
+                "split the request"
+            )
+        now = self.clock()
+        pending = _Pending(
+            rows=rows,
+            n=n,
+            future=Future(),
+            enqueued_at=now,
+            deadline_at=None if deadline_s is None else now + float(deadline_s),
+            meta=dict(meta or {}),
+        )
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("microbatcher is stopped")
+            if self._rows_total + n > self.max_queue_rows:
+                if self.metrics:
+                    self.metrics.count("rejected")
+                raise QueueFull(
+                    f"queue full ({self._rows_total}/{self.max_queue_rows} "
+                    f"rows); retry after {self.max_delay_s:.3f}s",
+                    retry_after_s=self.max_delay_s,
+                )
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = _KeyQueue(dispatch=dispatch)
+            q.requests.append(pending)
+            q.rows_queued += n
+            self._rows_total += n
+            if self.metrics:
+                self.metrics.count("requests")
+                self.metrics.observe("request_rows", n)
+                self.metrics.gauge("queue_depth_rows", self._rows_total)
+            # capacity flush: a full largest bucket is waiting — wake now
+            self._cond.notify_all()
+        return pending.future
+
+    # -- flushing ------------------------------------------------------------
+    def _due(self, key: Any, q: _KeyQueue, now: float, force: bool) -> bool:
+        if not q.requests:
+            return False
+        if force or q.rows_queued >= self.menu.max_size:
+            return True
+        head = q.requests[0]
+        return now - head.enqueued_at >= self.max_delay_s or (
+            head.deadline_at is not None and head.deadline_at <= now
+        )
+
+    def _next_deadline(self, now: float) -> float | None:
+        """Seconds until the nearest flush obligation, None when idle."""
+        nearest = None
+        for q in self._queues.values():
+            if not q.requests:
+                continue
+            if q.rows_queued >= self.menu.max_size:
+                return 0.0
+            head = q.requests[0]
+            t = head.enqueued_at + self.max_delay_s
+            if head.deadline_at is not None:
+                t = min(t, head.deadline_at)
+            nearest = t if nearest is None else min(nearest, t)
+        return None if nearest is None else max(0.0, nearest - now)
+
+    def _assemble(self, key: Any, q: _KeyQueue, now: float):
+        """Pop one FIFO batch for ``key``; cancels expired requests."""
+        batch: list[_Pending] = []
+        rows_total = 0
+        while q.requests and rows_total + q.requests[0].n <= self.menu.max_size:
+            p = q.requests.popleft()
+            q.rows_queued -= p.n
+            self._rows_total -= p.n
+            if p.deadline_at is not None and p.deadline_at <= now:
+                if self.metrics:
+                    self.metrics.count("timeouts")
+                p.future.set_exception(
+                    DeadlineExceeded(
+                        f"deadline passed after {now - p.enqueued_at:.3f}s in "
+                        "queue; cancelled before dispatch"
+                    )
+                )
+                continue
+            batch.append(p)
+            rows_total += p.n
+        return batch, rows_total
+
+    def flush_due(self, now: float | None = None, force: bool = False) -> int:
+        """Assemble and dispatch every due batch; returns batches dispatched.
+
+        The flusher thread's body — also the synchronous entry point for
+        fake-clock tests (construct with ``start=False``). ``force`` treats
+        every non-empty queue as past its flush delay (the drain path)
+        without touching deadline semantics: request deadlines are still
+        judged against the real ``now``.
+        """
+        if now is None:
+            now = self.clock()
+        todo = []
+        with self._lock:
+            for key, q in list(self._queues.items()):
+                # one batch per due key per pass; a backlog > max bucket
+                # stays due and drains on immediate subsequent passes
+                if self._due(key, q, now, force):
+                    batch, rows_total = self._assemble(key, q, now)
+                    if batch:
+                        todo.append((key, q.dispatch, batch, rows_total))
+                # drop drained queues: the key space is client-controlled
+                # (ε sweeps), so idle keys must not accumulate flusher work
+                if not q.requests:
+                    del self._queues[key]
+            if self.metrics:
+                self.metrics.gauge("queue_depth_rows", self._rows_total)
+        for key, dispatch, batch, rows_total in todo:
+            self._dispatch(key, dispatch, batch, rows_total)
+        return len(todo)
+
+    def _dispatch(self, key, dispatch, batch: list[_Pending], rows_total: int):
+        with self._dispatch_lock:
+            self._dispatch_one(key, dispatch, batch, rows_total)
+
+    def _dispatch_one(self, key, dispatch, batch: list[_Pending], rows_total: int):
+        bucket = self.menu.bucket_for(rows_total)
+        with self._lock:
+            self._batch_seq += 1
+            seq = self._batch_seq
+        x = (
+            batch[0].rows
+            if len(batch) == 1 and batch[0].n == rows_total
+            else np.concatenate([p.rows for p in batch], axis=0)
+        )
+        x_pad, _ = pad_states(x, None, bucket=bucket)
+        t0 = self.clock()
+        try:
+            out = np.asarray(dispatch(x_pad))
+            if out.shape[0] != bucket:
+                raise ValueError(
+                    f"dispatch returned leading axis {out.shape[0]}, "
+                    f"expected bucket size {bucket}"
+                )
+        except BaseException as e:  # noqa: BLE001 — isolation boundary
+            if self.metrics:
+                self.metrics.count("batch_failures")
+            err = BatchExecutionError(key, e)
+            for p in batch:
+                p.future.set_exception(err)
+            return
+        dt = self.clock() - t0
+        occupancy = rows_total / bucket
+        if self.metrics:
+            self.metrics.count("batches")
+            self.metrics.count("dispatched_rows", rows_total)
+            self.metrics.count("padded_rows", bucket - rows_total)
+            self.metrics.observe("batch_occupancy", occupancy)
+            self.metrics.observe("dispatch_s", dt)
+        off = 0
+        for p in batch:
+            meta = dict(
+                p.meta,
+                bucket_size=bucket,
+                batch_rows=rows_total,
+                batch_requests=len(batch),
+                batch_occupancy=occupancy,
+                batch_seq=seq,
+                queued_s=round(t0 - p.enqueued_at, 6),
+                dispatch_s=round(dt, 6),
+            )
+            p.future.set_result((out[off : off + p.n].copy(), meta))
+            off += p.n
+
+    # -- lifecycle -----------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                wait = self._next_deadline(self.clock())
+                if wait is None or wait > 0:
+                    self._cond.wait(timeout=wait)
+                if self._stop:
+                    return
+            self.flush_due()
+
+    def queue_depth_rows(self) -> int:
+        with self._lock:
+            return self._rows_total
+
+    def stop(self, drain: bool = True):
+        """Stop the flusher; with ``drain``, flush whatever is queued first
+        (flush delays are waived; request deadlines keep real-time
+        semantics — a request with time remaining is dispatched, not
+        cancelled)."""
+        if drain:
+            while True:
+                with self._lock:
+                    pending = self._rows_total
+                if pending == 0:
+                    break
+                if not self.flush_due(force=True):
+                    break
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

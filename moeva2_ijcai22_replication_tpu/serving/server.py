@@ -1,0 +1,160 @@
+"""Stdlib-only JSON/HTTP front for :class:`~.service.AttackService`.
+
+Three routes, no dependencies beyond ``http.server``:
+
+- ``POST /attack`` — body ``{"domain", "rows": [[...]], "attack",
+  "loss_evaluation", "eps", "eps_step", "budget", "deadline_s",
+  "request_id", "params"}``; replies ``{"request_id", "x_adv", "meta"}``.
+  Error mapping: 400 invalid request / unparseable body, 413 request larger
+  than the biggest bucket, 429 + ``Retry-After`` on backpressure, 504 on a
+  queued deadline or server-side wait timeout, 500 when the request's batch
+  failed.
+- ``GET /healthz`` — liveness + queue depth.
+- ``GET /metrics`` — the :class:`~..utils.observability.ServiceMetrics`
+  snapshot plus engine/artifact cache stats, JSON.
+
+``ThreadingHTTPServer`` gives one handler thread per connection; handlers
+block on the request future while the single flusher/dispatch thread keeps
+the device fed — the HTTP layer adds concurrency, not parallelism, which is
+exactly the microbatcher's input shape.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from concurrent.futures import TimeoutError as FuturesTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .batcher import BatchExecutionError, DeadlineExceeded, QueueFull, RequestTooLarge
+from .service import AttackRequest, AttackService, InvalidRequest
+
+
+def _jsonable(obj):
+    """JSON with NaN/Inf scrubbed to null (strict parsers choke on them)."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "tolist"):  # numpy array or scalar
+        return _jsonable(obj.tolist())
+    return obj
+
+
+class AttackHTTPHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "AttackHTTPServer"
+
+    # -- plumbing ------------------------------------------------------------
+    def _send(self, code: int, obj: dict, headers: dict | None = None):
+        body = json.dumps(_jsonable(obj)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):
+        service = self.server.service
+        if self.path == "/healthz":
+            self._send(200, service.healthz())
+        elif self.path == "/metrics":
+            self._send(200, service.metrics_snapshot())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        # always drain the body: HTTP/1.1 keep-alive would otherwise parse
+        # the unread bytes as the next request line on a reused connection
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._send(400, {"error": "bad Content-Length header"})
+            self.close_connection = True
+            return
+        body = self.rfile.read(length)
+        if self.path != "/attack":
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        service = self.server.service
+        try:
+            payload = json.loads(body)
+            req = AttackRequest(
+                domain=payload["domain"],
+                x=payload["rows"],
+                attack=payload.get("attack", "pgd"),
+                loss_evaluation=payload.get("loss_evaluation", "flip"),
+                eps=float(payload.get("eps", 0.1)),
+                eps_step=payload.get("eps_step"),
+                budget=int(payload.get("budget", 10)),
+                deadline_s=payload.get("deadline_s"),
+                request_id=payload.get("request_id"),
+                params=payload.get("params"),
+            )
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad request body: {e!r}"})
+            return
+        try:
+            resp = service.attack(req, timeout=self.server.request_timeout_s)
+        except InvalidRequest as e:
+            self._send(400, {"error": str(e)})
+        except RequestTooLarge as e:
+            self._send(413, {"error": str(e)})
+        except QueueFull as e:
+            self._send(
+                429,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                headers={"Retry-After": f"{max(e.retry_after_s, 0.001):.3f}"},
+            )
+        except DeadlineExceeded as e:
+            self._send(504, {"error": str(e)})
+        except (TimeoutError, FuturesTimeout) as e:  # result(timeout=) expired
+            self._send(504, {"error": f"server-side wait timed out: {e!r}"})
+        except BatchExecutionError as e:
+            self._send(500, {"error": str(e)})
+        else:
+            self._send(
+                200,
+                {
+                    "request_id": resp.request_id,
+                    "x_adv": resp.x_adv,
+                    "meta": resp.meta,
+                },
+            )
+
+
+class AttackHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(
+        self,
+        addr: tuple[str, int],
+        service: AttackService,
+        *,
+        request_timeout_s: float = 60.0,
+        verbose: bool = False,
+    ):
+        super().__init__(addr, AttackHTTPHandler)
+        self.service = service
+        self.request_timeout_s = request_timeout_s
+        self.verbose = verbose
+
+
+def serve(
+    service: AttackService,
+    host: str = "127.0.0.1",
+    port: int = 8787,
+    **kw,
+) -> AttackHTTPServer:
+    """Bind and return the server (caller runs ``serve_forever``; port 0
+    picks an ephemeral port — read it back from ``server.server_address``)."""
+    return AttackHTTPServer((host, port), service, **kw)

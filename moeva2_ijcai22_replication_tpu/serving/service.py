@@ -1,0 +1,404 @@
+"""AttackService: request -> cached engine -> microbatched dispatch.
+
+The request path reuses the grid substrate wholesale: artifacts come from
+``experiments.common.ARTIFACTS`` (one disk read per file per process),
+engines come from ``experiments.common.ENGINES`` through the *same*
+cache-key builders the batch runners use (``experiments.pgd._cached_attack``
+/ ``experiments.moeva._cached_engine``), so a service and a grid running in
+one process share compiled executables. What serving adds is the traffic
+shape: concurrent, variably-sized requests are coalesced by the
+:class:`~.batcher.Microbatcher` into full fixed-shape batches.
+
+Batch keys: requests only coalesce when one device dispatch can serve all
+of them, i.e. when they agree on the engine static config AND on the
+runtime scalars that are batch-wide arguments of the compiled program
+(ε, ε-step, budget — `attacks/pgd/engine.py` feeds them as traced scalars,
+one value per dispatch). The key is the config hash of exactly that tuple;
+distinct ε values therefore queue separately but still share the same
+compiled program per bucket size.
+
+Bit-identity: plain ConstrainedPGD (no restarts, no history) is per-row
+deterministic with no batch-shape-dependent RNG, so a request's rows give
+bit-identical results whether dispatched alone or coalesced+padded into any
+bucket — the contract tests pin. AutoPGD/restart programs draw
+batch-shaped random starts and MoEvA folds chunk-shaped PRNG keys, so those
+families serve fine but are NOT bit-identical across batch shapes; the
+response metadata carries ``bit_identical`` so callers know which contract
+they got.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
+from ..attacks.sharding import describe_mesh
+from ..experiments import common
+from ..utils.config import get_dict_hash
+from ..utils.observability import ServiceMetrics
+from .batcher import BucketMenu, Microbatcher
+
+
+class InvalidRequest(ValueError):
+    """The request can never succeed (unknown domain, bad shape, bad family)."""
+
+
+@dataclass
+class AttackRequest:
+    """One caller's attack: rows to perturb plus the attack coordinates."""
+
+    domain: str
+    x: Any  # (n_rows, n_features) unscaled feature rows
+    attack: str = "pgd"
+    loss_evaluation: str = "flip"
+    eps: float = 0.1
+    eps_step: float | None = None  # default: the runner's fixed 0.1
+    budget: int = 10
+    deadline_s: float | None = None  # relative; cancelled-if-exceeded pre-dispatch
+    request_id: str | None = None
+    params: dict | None = None  # extra engine config (moeva n_pop, nb_random, …)
+
+
+@dataclass
+class AttackResponse:
+    request_id: str
+    x_adv: np.ndarray
+    meta: dict
+
+
+@dataclass
+class _Resolved:
+    """Per-(run-config) dispatch closure + its response metadata."""
+
+    key: tuple
+    dispatch: Callable[[np.ndarray], np.ndarray]
+    mesh: Any
+    bit_identical: bool
+    n_features: int
+    execution: dict
+    meta: dict = field(default_factory=dict)
+
+
+class AttackService:
+    """In-process attack server: bounded queues, microbatched execution.
+
+    ``domains`` maps a request's ``domain`` name to an experiment-style
+    config dict (``project_name`` + ``paths{features, constraints, model,
+    ml_scaler}`` + optional ``norm`` / ``system.mesh_devices`` / engine
+    defaults) — the same shape the batch runners consume, so a committed
+    ``config/*.yaml`` can be served as-is.
+    """
+
+    def __init__(
+        self,
+        domains: dict[str, dict],
+        *,
+        bucket_sizes=(8, 16, 32, 64, 128, 256),
+        max_delay_s: float = 0.010,
+        max_queue_rows: int = 4096,
+        seed: int = 42,
+        metrics: ServiceMetrics | None = None,
+        stream=None,
+        clock: Callable[[], float] | None = None,
+        start: bool = True,
+    ):
+        self.domains = dict(domains)
+        self.seed = int(seed)
+        self.metrics = metrics if metrics is not None else ServiceMetrics()
+        self.stream = stream
+        self.clock = clock or time.monotonic
+        self.menu = BucketMenu(bucket_sizes)
+        self.batcher = Microbatcher(
+            self.menu,
+            max_delay_s=max_delay_s,
+            max_queue_rows=max_queue_rows,
+            metrics=self.metrics,
+            clock=self.clock,
+            start=start,
+        )
+        self._resolved: dict[tuple, _Resolved] = {}
+        self._lock = threading.Lock()
+        # misses resolve under one lock: the process-wide ENGINES/ARTIFACTS
+        # caches are grid-runner substrate (single-threaded there) and not
+        # thread-safe — a racing pair of resolves would build two engine
+        # instances for one key and compile every bucket shape twice
+        self._resolve_lock = threading.Lock()
+        self._t0 = time.time()
+
+    # -- resolution ----------------------------------------------------------
+    def _pseudo_config(self, cfg: dict, req: AttackRequest) -> dict:
+        """The experiment-config equivalent of this request — the dict the
+        batch runners' engine-cache key builders understand."""
+        pseudo = {
+            "project_name": cfg["project_name"],
+            "paths": dict(cfg["paths"]),
+            "norm": cfg.get("norm", "inf"),
+            "attack_name": req.attack,
+            "loss_evaluation": req.loss_evaluation,
+            "budget": int(req.budget),
+            "system": dict(cfg.get("system", {"mesh_devices": 0})),
+            # moeva engine-shape defaults, overridable per domain/request
+            "n_pop": cfg.get("n_pop", 16),
+            "n_offsprings": cfg.get("n_offsprings", 8),
+        }
+        for k in (
+            "constraints_optim",
+            "nb_random",
+            "archive_size",
+            "init",
+            "init_eps",
+            "init_ratio",
+            "assoc_block",
+            "max_states_per_call",
+        ):
+            if k in cfg:
+                pseudo[k] = cfg[k]
+        if req.params:
+            pseudo.update(req.params)
+        return pseudo
+
+    def resolve(self, req: AttackRequest) -> _Resolved:
+        """Request -> cached dispatch closure (artifacts + engine from the
+        process-wide caches; one closure per run config)."""
+        cfg = self.domains.get(req.domain)
+        if cfg is None:
+            raise InvalidRequest(
+                f"unknown domain {req.domain!r}; serving {sorted(self.domains)}"
+            )
+        if req.attack not in ("pgd", "moeva"):
+            raise InvalidRequest(f"unknown attack family {req.attack!r}")
+        if req.attack == "pgd" and "sat" in req.loss_evaluation:
+            raise InvalidRequest(
+                "the MILP repair stage is not served (host-side solver, "
+                "unbounded latency); run PGD+SAT through the batch runners"
+            )
+        pseudo = self._pseudo_config(cfg, req)
+        eps = float(req.eps)
+        if req.eps_step is not None:
+            eps_step = float(req.eps_step)
+        else:
+            # the batch runner's defaults (experiments/pgd.py): AutoPGD uses
+            # eps/3, plain PGD a fixed 0.1 — served numbers must match what
+            # a runner would commit for the same coordinates
+            eps_step = eps / 3 if "autopgd" in req.loss_evaluation else 0.1
+        # ε/ε-step are batch-wide runtime scalars for PGD, so they partition
+        # batches there; the MoEvA dispatch never reads them, so keying on
+        # them would only fragment coalescing
+        key_scalars = (eps, eps_step) if req.attack == "pgd" else (None, None)
+        key = (req.domain, req.attack, get_dict_hash(pseudo)) + key_scalars
+        with self._lock:
+            res = self._resolved.get(key)
+        if res is not None:
+            return res
+        with self._resolve_lock:
+            return self._resolve_miss(key, pseudo, req, eps, eps_step)
+
+    def _resolve_miss(
+        self, key: tuple, pseudo: dict, req: AttackRequest, eps: float, eps_step: float
+    ) -> _Resolved:
+        with self._lock:
+            res = self._resolved.get(key)
+        if res is not None:  # lost the race to an identical resolve
+            return res
+
+        constraints = common.load_constraints(pseudo)
+        scaler = common.load_scaler(pseudo)
+        surrogate = common.load_surrogate(pseudo)
+        n_features = constraints.schema.n_features
+
+        if req.attack == "pgd":
+            from ..experiments.pgd import _cached_attack
+
+            engine = _cached_attack(pseudo, surrogate, constraints, scaler)
+            engine.seed = self.seed
+            # mirror the batch runner's open-ball ε (experiments/pgd.py):
+            # served numbers must match what a runner would commit
+            eps_run = eps - 0.000001
+            budget = int(req.budget)
+            feature_types = constraints.get_feature_type()
+            bit_identical = (
+                type(engine) is ConstrainedPGD
+                and engine.num_random_init == 0
+                and not engine.record_loss
+            )
+
+            def dispatch(x_batch: np.ndarray) -> np.ndarray:
+                # the poisoned-batch isolation boundary: a constraint-invalid
+                # row fails the whole bucket here, before any device work
+                constraints.check_constraints_error(x_batch)
+                traces0 = engine.trace_count
+                x_scaled = np.asarray(scaler.transform(x_batch))
+                y = np.asarray(surrogate.predict_proba(x_scaled)).argmax(-1)
+                x_adv = engine.generate(
+                    x_scaled, y, eps=eps_run, eps_step=eps_step, max_iter=budget
+                )
+                self.metrics.count("compiles", engine.trace_count - traces0)
+                x_adv = np.asarray(scaler.inverse(x_adv))
+                return round_ints_toward_initial(x_adv, x_batch, feature_types)
+
+            chunk = None
+        else:  # moeva
+            from ..experiments.moeva import _cached_engine
+
+            engine = _cached_engine(pseudo, surrogate, constraints, scaler)
+            budget = int(req.budget)
+            seed = self.seed
+            bit_identical = False  # chunk/batch-shaped PRNG key folds
+
+            def dispatch(x_batch: np.ndarray) -> np.ndarray:
+                constraints.check_constraints_error(x_batch)
+                traces0 = engine.trace_count
+                # host-side dispatch knobs, per the engine-cache contract
+                engine.n_gen = budget
+                engine.seed = seed
+                result = engine.generate(x_batch, 1)
+                self.metrics.count("compiles", engine.trace_count - traces0)
+                return np.asarray(result.x_ml)
+
+            chunk = engine.effective_states_chunk()
+
+        mesh = engine.mesh
+        if mesh is not None:
+            # revalidate the menu against this domain's mesh: every bucket
+            # must satisfy the states-axis divisibility contract
+            BucketMenu(self.menu.sizes, mesh_size=mesh.size)
+        res = _Resolved(
+            key=key,
+            dispatch=dispatch,
+            mesh=mesh,
+            bit_identical=bit_identical,
+            n_features=n_features,
+            execution={
+                "max_states_per_call": chunk,
+                "mesh": describe_mesh(mesh),
+                "bucket_menu": list(self.menu.sizes),
+            },
+            meta={
+                "domain": req.domain,
+                "attack": req.attack,
+                "loss_evaluation": req.loss_evaluation,
+                "eps": eps,
+                "eps_step": eps_step,
+                "budget": int(req.budget),
+            },
+        )
+        with self._lock:
+            self._resolved[key] = res
+        return res
+
+    def _validate(self, req: AttackRequest, res: _Resolved) -> np.ndarray:
+        x = np.asarray(req.x, dtype=np.float64)
+        if x.ndim != 2 or x.shape[0] < 1:
+            raise InvalidRequest(
+                f"x must be (n_rows >= 1, n_features), got {x.shape}"
+            )
+        if x.shape[1] != res.n_features:
+            raise InvalidRequest(
+                f"x has {x.shape[1]} features, domain {req.domain!r} "
+                f"expects {res.n_features}"
+            )
+        return x
+
+    # -- request path --------------------------------------------------------
+    def submit(self, req: AttackRequest):
+        """Validate + enqueue; returns a Future of ``(x_adv, meta)``.
+
+        Raises :class:`InvalidRequest` / :class:`~.batcher.QueueFull` /
+        :class:`~.batcher.RequestTooLarge` synchronously; queued failures
+        (deadline, batch errors) surface through the future.
+        """
+        res = self.resolve(req)
+        x = self._validate(req, res)
+        rid = req.request_id or uuid.uuid4().hex[:12]
+        t_submit = self.clock()
+        fut = self.batcher.submit(
+            res.key,
+            res.dispatch,
+            x,
+            deadline_s=req.deadline_s,
+            meta=dict(
+                res.meta,
+                request_id=rid,
+                rows=int(x.shape[0]),
+                bit_identical=res.bit_identical,
+                execution=res.execution,
+            ),
+        )
+
+        def _done(f):
+            latency = self.clock() - t_submit
+            ok = f.exception() is None
+            self.metrics.observe("latency_s", latency)
+            self.metrics.count("completed" if ok else "failed")
+            if self.stream is not None:
+                self.stream.log_event(
+                    "request",
+                    id=rid,
+                    domain=req.domain,
+                    attack=req.attack,
+                    rows=int(x.shape[0]),
+                    status="ok" if ok else type(f.exception()).__name__,
+                    latency_s=round(latency, 6),
+                )
+
+        fut.add_done_callback(_done)
+        return fut
+
+    def attack(
+        self, req: AttackRequest, timeout: float | None = None
+    ) -> AttackResponse:
+        """Blocking request path: submit, wait, unwrap."""
+        fut = self.submit(req)
+        x_adv, meta = fut.result(timeout=timeout)
+        return AttackResponse(
+            request_id=meta["request_id"], x_adv=x_adv, meta=meta
+        )
+
+    def execute_direct(
+        self, req: AttackRequest, bucket: int | None = None
+    ) -> np.ndarray:
+        """The un-coalesced oracle: run this request's rows through the same
+        dispatch pipeline, alone. With ``bucket``, the lone request is padded
+        to that menu size — the serving bit-identity contract compares a
+        coalesced request against exactly this: same compiled program, same
+        shape, no batch-mates. Per-row results must match BIT-IDENTICALLY
+        (every op in the served engines is per-row at a fixed shape).
+        Without ``bucket``, rows run at their own shape (padded only to a
+        mesh multiple, like the batch runners) — across *different* shapes
+        XLA may pick differently-tiled kernels, so equality is only
+        near-exact (~1e-5 in fp32), an engine property the serving layer
+        inherits and documents rather than hides. Not safe concurrently
+        with live traffic — engines are single-dispatch objects."""
+        res = self.resolve(req)
+        x = self._validate(req, res)
+        if bucket is not None:
+            x_run, n_orig = common.pad_states(x, res.mesh, bucket=bucket)
+        else:
+            x_run, n_orig = common.pad_states(x, res.mesh)
+        return np.asarray(res.dispatch(x_run))[:n_orig]
+
+    # -- introspection -------------------------------------------------------
+    def healthz(self) -> dict:
+        return {
+            "ok": True,
+            "uptime_s": round(time.time() - self._t0, 3),
+            "domains": sorted(self.domains),
+            "queue_depth_rows": self.batcher.queue_depth_rows(),
+            "bucket_menu": list(self.menu.sizes),
+        }
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["engine_cache"] = common.ENGINES.stats()
+        snap["artifact_cache"] = common.ARTIFACTS.stats()
+        snap["resolved_run_configs"] = len(self._resolved)
+        return snap
+
+    def close(self):
+        self.batcher.stop()

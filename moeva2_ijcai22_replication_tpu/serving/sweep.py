@@ -1,0 +1,140 @@
+"""Offered-load sweep: the serving layer's bench harness.
+
+Drives an in-process :class:`~.service.AttackService` at a ladder of
+offered request rates and reports, per level, what the request path
+actually delivered: achieved throughput (requests and rows per second),
+client-observed latency quantiles, mean batch occupancy (how full the
+fixed-shape buckets ran), and reject/timeout/failure counts. No network,
+no subprocesses — this is the ``bench.py --serving`` record and the smoke
+test's evidence that the microbatcher fills buckets instead of dispatching
+per request.
+
+Pacing is open-loop (submit at the offered rate regardless of completions,
+the standard serving-bench discipline — closed-loop pacing hides queueing
+collapse), with a bounded in-flight window as a safety valve so a
+pathological level cannot accumulate unbounded futures.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+from ..utils.observability import percentile
+from .batcher import DeadlineExceeded, QueueFull, RequestTooLarge
+from .service import AttackRequest, AttackService
+
+
+def run_level(
+    service: AttackService,
+    make_request: Callable[[int], AttackRequest],
+    offered_rps: float,
+    n_requests: int,
+    *,
+    timeout_s: float = 120.0,
+    max_in_flight: int = 1024,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict:
+    """One offered-load level: submit ``n_requests`` paced at
+    ``offered_rps``, wait for completion, report the level record."""
+    latencies: list[float] = []
+    occupancies: list[float] = []
+    rows_done = 0
+    rejected = timeouts = failed = 0
+    in_flight: list[tuple[float, dict, object]] = []
+
+    def reap(block: bool):
+        nonlocal rows_done, timeouts, failed
+        remaining = []
+        for t_sub, stamp, fut in in_flight:
+            if not block and not fut.done():
+                remaining.append((t_sub, stamp, fut))
+                continue
+            try:
+                x_adv, meta = fut.result(timeout=timeout_s)
+            except Exception as e:  # noqa: BLE001 — bench counts, not raises
+                if isinstance(e, DeadlineExceeded):
+                    timeouts += 1
+                else:
+                    failed += 1
+                continue
+            # completion was stamped by the done-callback, so lazy reaping
+            # cannot inflate the measured latency
+            latencies.append(stamp.get("t_done", clock()) - t_sub)
+            occupancies.append(meta["batch_occupancy"])
+            rows_done += int(meta["rows"])
+        in_flight[:] = remaining
+
+    t_start = clock()
+    period = 1.0 / offered_rps if offered_rps > 0 else 0.0
+    for i in range(n_requests):
+        target = t_start + i * period
+        delta = target - clock()
+        if delta > 0:
+            sleep(delta)
+        if len(in_flight) >= max_in_flight:
+            reap(block=True)
+        t_sub = clock()
+        try:
+            fut = service.submit(make_request(i))
+        except (QueueFull, RequestTooLarge):
+            rejected += 1
+            continue
+        stamp: dict = {}
+        fut.add_done_callback(
+            lambda f, s=stamp: s.__setitem__("t_done", clock())
+        )
+        in_flight.append((t_sub, stamp, fut))
+        if len(in_flight) % 64 == 0:
+            reap(block=False)
+    reap(block=True)
+    duration = max(clock() - t_start, 1e-9)
+
+    lat_sorted = sorted(latencies)
+    n_ok = len(latencies)
+    return {
+        "offered_rps": offered_rps,
+        "n_requests": n_requests,
+        "completed": n_ok,
+        "rejected": rejected,
+        "deadline_timeouts": timeouts,
+        "failed": failed,
+        "duration_s": round(duration, 3),
+        "throughput_rps": round(n_ok / duration, 2),
+        "throughput_rows_s": round(rows_done / duration, 1),
+        # None, not NaN, when a level completed nothing: the record is
+        # strict JSON (RFC 8259 has no NaN) for jq and cross-language readers
+        "p50_ms": round(percentile(lat_sorted, 0.50) * 1e3, 2) if n_ok else None,
+        "p99_ms": round(percentile(lat_sorted, 0.99) * 1e3, 2) if n_ok else None,
+        "mean_batch_occupancy": round(
+            sum(occupancies) / len(occupancies), 4
+        )
+        if occupancies
+        else None,
+    }
+
+
+def offered_load_sweep(
+    service: AttackService,
+    make_request: Callable[[int], AttackRequest],
+    offered_rps_levels: Sequence[float],
+    n_requests: int,
+    **kw,
+) -> dict:
+    """Sweep the rate ladder; returns the ``serving`` bench record:
+    per-level results plus the service-side counter/cache totals."""
+    levels = [
+        run_level(service, make_request, rps, n_requests, **kw)
+        for rps in offered_rps_levels
+    ]
+    snap = service.metrics_snapshot()
+    return {
+        "bucket_menu": list(service.menu.sizes),
+        "max_delay_s": service.batcher.max_delay_s,
+        "levels": levels,
+        "counters": snap["counters"],
+        "engine_cache": snap["engine_cache"],
+        "latency": snap["streams"].get("latency_s"),
+        "batch_occupancy": snap["streams"].get("batch_occupancy"),
+    }

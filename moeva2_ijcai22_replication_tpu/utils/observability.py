@@ -20,7 +20,9 @@ across points, which is what makes executable reuse visible: a healthy
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import threading
 import time
 
 
@@ -62,6 +64,82 @@ class PhaseTimer:
             self.add(f"{name}_compile" if traced else f"{name}_run", dt)
             if traced:
                 self.count("traces", traced)
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile on an already-sorted sample list (NaN when
+    empty) — the one quantile definition shared by ServiceMetrics, the
+    offered-load sweep, and the loadgen CLI."""
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+class ServiceMetrics:
+    """Thread-safe counters / gauges / sample streams for the serving layer.
+
+    :class:`PhaseTimer` models one experiment's linear lifecycle; a service
+    is concurrent and unbounded, so this keeps monotonic ``counters``
+    (requests, rejects, timeouts, batches, compiles), point-in-time
+    ``gauges`` (queue depth), and bounded ``observe`` streams (latency,
+    batch occupancy) whose quantiles back ``/metrics``, the serving bench
+    record, and per-response metadata. Streams keep the most recent
+    ``window`` samples (quantiles reflect recent traffic, memory stays
+    bounded) plus an unbounded count/sum so rates and means never lose
+    history.
+    """
+
+    def __init__(self, window: int = 8192):
+        self._lock = threading.Lock()
+        self._window = window
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        self._samples: dict[str, collections.deque] = {}
+        self._totals: dict[str, tuple[int, float]] = {}  # name -> (n, sum)
+
+    def count(self, name: str, n: int = 1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float):
+        with self._lock:
+            self.gauges[name] = value
+
+    def observe(self, name: str, value: float):
+        with self._lock:
+            dq = self._samples.get(name)
+            if dq is None:
+                dq = self._samples[name] = collections.deque(maxlen=self._window)
+            dq.append(float(value))
+            n, s = self._totals.get(name, (0, 0.0))
+            self._totals[name] = (n + 1, s + float(value))
+
+    def percentile(self, name: str, q: float) -> float:
+        with self._lock:
+            vals = sorted(self._samples.get(name, ()))
+        return percentile(vals, q)
+
+    def snapshot(self) -> dict:
+        """JSON-ready state: counters, gauges, and per-stream
+        ``{count, mean, p50, p99, max}`` (quantiles over the recent
+        window, count/mean over the full history)."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            streams = {k: sorted(v) for k, v in self._samples.items()}
+            totals = dict(self._totals)
+        out: dict = {"counters": counters, "gauges": gauges, "streams": {}}
+        for name, vals in streams.items():
+            n, s = totals.get(name, (len(vals), sum(vals)))
+            out["streams"][name] = {
+                "count": n,
+                "mean": (s / n) if n else None,
+                "p50": percentile(vals, 0.50) if vals else None,
+                "p99": percentile(vals, 0.99) if vals else None,
+                "max": vals[-1] if vals else None,
+            }
+        return out
 
 
 @contextlib.contextmanager

@@ -56,6 +56,12 @@ class ExperimentStream:
             ev["step"] = step
         self._emit(ev)
 
+    def log_event(self, kind: str, **fields):
+        """Generic structured event (serving uses this as a request log:
+        ``{"event": "request", "id": ..., "status": ..., "latency_s": ...}``
+        — same transport, same readers as the experiment streams)."""
+        self._emit({"event": kind, **fields})
+
     def log_series(self, name: str, values, start_step: int = 0):
         """Stream a recorded per-step history tensor as one metric event per
         step — the post-hoc equivalent of the reference's per-iteration

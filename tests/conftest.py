@@ -71,6 +71,7 @@ SLOW_TESTS = {
         "test_moeva_runner_streams_events",
         "test_end_to_end_and_skip",
         "test_history_artifact",
+        "test_moeva_metrics_execution_roundtrip",
     },
     "test_softmax_genes.py": {
         "test_attack_keeps_softmax_population_on_simplex",

@@ -1,0 +1,513 @@
+"""Serving-layer tests: microbatcher semantics + attack-service contracts.
+
+The batcher core is exercised hardware-free with numpy dispatch functions
+and a fake clock (bucketing, FIFO fairness, deadline flush, backpressure,
+timeout cancellation, poisoned-batch isolation). The tier-1 smoke drives
+>= 64 concurrent mixed-size PGD requests through a live threaded service
+and pins the serving contract: results bit-identical to direct engine
+calls, a bounded compile count (at most one program per (loss-strategy,
+bucket-size)), and a populated offered-load serving record. The HTTP front
++ loadgen end-to-end ride in the slow tier.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.serving import (
+    AttackRequest,
+    AttackService,
+    BatchExecutionError,
+    BucketMenu,
+    DeadlineExceeded,
+    Microbatcher,
+    QueueFull,
+    RequestTooLarge,
+)
+from moeva2_ijcai22_replication_tpu.utils.observability import ServiceMetrics
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# batcher core (no engines, no jax programs)
+# ---------------------------------------------------------------------------
+
+
+def make_batcher(sizes=(8,), max_delay_s=0.01, max_queue_rows=64, clock=None):
+    metrics = ServiceMetrics()
+    b = Microbatcher(
+        BucketMenu(sizes),
+        max_delay_s=max_delay_s,
+        max_queue_rows=max_queue_rows,
+        metrics=metrics,
+        clock=clock or FakeClock(),
+        start=False,
+    )
+    return b, metrics
+
+
+class TestBucketMenu:
+    def test_smallest_fit_and_too_large(self):
+        menu = BucketMenu((8, 16, 32))
+        assert [menu.bucket_for(n) for n in (1, 8, 9, 16, 32)] == [8, 8, 16, 16, 32]
+        with pytest.raises(RequestTooLarge):
+            menu.bucket_for(33)
+
+    def test_mesh_alignment_enforced(self):
+        BucketMenu((8, 16), mesh_size=8)
+        with pytest.raises(ValueError, match="mesh"):
+            BucketMenu((8, 12), mesh_size=8)
+
+
+class TestBatcherCore:
+    def test_deadline_flush_with_fake_clock(self):
+        clock = FakeClock()
+        b, metrics = make_batcher(max_delay_s=0.01, clock=clock)
+        batches = []
+        fut = b.submit("k", lambda x: batches.append(x.shape) or x, np.ones((2, 3)))
+        # before the flush deadline nothing dispatches
+        clock.advance(0.005)
+        assert b.flush_due() == 0 and not fut.done()
+        # past it, the lone request pads to the bucket and dispatches
+        clock.advance(0.006)
+        assert b.flush_due() == 1
+        out, meta = fut.result(timeout=0)
+        assert batches == [(8, 3)]  # padded to the bucket shape
+        assert out.shape == (2, 3)  # trimmed back to the request rows
+        assert meta["bucket_size"] == 8 and meta["batch_occupancy"] == 2 / 8
+
+    def test_capacity_flush_before_deadline(self):
+        clock = FakeClock()
+        b, _ = make_batcher(sizes=(4,), clock=clock)
+        futs = [b.submit("k", lambda x: x, np.ones((2, 1))) for _ in range(2)]
+        # a full largest bucket is due immediately, no deadline wait
+        assert b.flush_due() == 1
+        assert all(f.done() for f in futs)
+
+    def test_fifo_fairness_within_key(self):
+        """Assembly never skips past a request that doesn't fit: B (4 rows)
+        blocks C (2 rows) even though C alone would fit next to A."""
+        clock = FakeClock()
+        b, _ = make_batcher(sizes=(8,), clock=clock)
+        rows = lambda n, v: np.full((n, 1), v, dtype=float)
+        fa = b.submit("k", lambda x: x, rows(5, 1))
+        fb = b.submit("k", lambda x: x, rows(4, 2))
+        fc = b.submit("k", lambda x: x, rows(2, 3))
+        clock.advance(0.02)
+        assert b.flush_due() == 1  # batch 1: [A] (B does not fit 5+4 > 8)
+        assert b.flush_due() == 1  # batch 2: [B, C]
+        seq_a = fa.result(timeout=0)[1]["batch_seq"]
+        meta_b = fb.result(timeout=0)[1]
+        meta_c = fc.result(timeout=0)[1]
+        assert meta_b["batch_seq"] == meta_c["batch_seq"] == seq_a + 1
+        assert meta_b["batch_requests"] == 2 and meta_b["batch_rows"] == 6
+
+    def test_scatter_returns_each_requests_rows(self):
+        clock = FakeClock()
+        b, _ = make_batcher(sizes=(8,), clock=clock)
+        fa = b.submit("k", lambda x: x * 10, np.arange(6).reshape(3, 2) * 1.0)
+        fb = b.submit("k", lambda x: x * 10, np.arange(4).reshape(2, 2) + 100.0)
+        clock.advance(0.02)
+        b.flush_due()
+        np.testing.assert_array_equal(
+            fa.result(timeout=0)[0], np.arange(6).reshape(3, 2) * 10.0
+        )
+        np.testing.assert_array_equal(
+            fb.result(timeout=0)[0], (np.arange(4).reshape(2, 2) + 100.0) * 10.0
+        )
+
+    def test_backpressure_rejects_with_retry_after(self):
+        b, metrics = make_batcher(max_queue_rows=8)
+        b.submit("k", lambda x: x, np.ones((6, 1)))
+        with pytest.raises(QueueFull) as ei:
+            b.submit("k", lambda x: x, np.ones((3, 1)))
+        assert ei.value.retry_after_s > 0
+        assert metrics.counters["rejected"] == 1
+
+    def test_expired_request_cancelled_before_dispatch(self):
+        clock = FakeClock()
+        b, metrics = make_batcher(clock=clock)
+        calls = []
+        fut = b.submit(
+            "k", lambda x: calls.append(1) or x, np.ones((2, 1)), deadline_s=0.5
+        )
+        clock.advance(1.0)
+        b.flush_due()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=0)
+        assert calls == []  # never consumed device time
+        assert metrics.counters["timeouts"] == 1
+
+    def test_poisoned_batch_fails_its_mates_not_the_batcher(self):
+        clock = FakeClock()
+        b, metrics = make_batcher(clock=clock)
+
+        def dispatch(x):
+            if np.isnan(x).any():
+                raise ValueError("poison")
+            return x
+
+        f1 = b.submit("k", dispatch, np.ones((2, 1)))
+        f2 = b.submit("k", dispatch, np.full((2, 1), np.nan))
+        clock.advance(0.02)
+        b.flush_due()
+        for f in (f1, f2):
+            with pytest.raises(BatchExecutionError, match="poison"):
+                f.result(timeout=0)
+        assert metrics.counters["batch_failures"] == 1
+        # the batcher survives: the next clean batch goes through
+        f3 = b.submit("k", dispatch, np.ones((3, 1)))
+        clock.advance(0.02)
+        b.flush_due()
+        assert f3.result(timeout=0)[0].shape == (3, 1)
+
+    def test_request_larger_than_menu_rejected(self):
+        b, _ = make_batcher(sizes=(8, 16))
+        with pytest.raises(RequestTooLarge):
+            b.submit("k", lambda x: x, np.ones((17, 1)))
+
+    def test_keys_do_not_coalesce(self):
+        clock = FakeClock()
+        b, _ = make_batcher(clock=clock)
+        fa = b.submit("k1", lambda x: x + 1, np.zeros((2, 1)))
+        fb = b.submit("k2", lambda x: x + 2, np.zeros((2, 1)))
+        clock.advance(0.02)
+        assert b.flush_due() == 2  # one batch per key
+        assert fa.result(timeout=0)[0][0, 0] == 1
+        assert fb.result(timeout=0)[0][0, 0] == 2
+
+
+# ---------------------------------------------------------------------------
+# service over real engines (tiny synthetic LCLD artifact family)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Self-contained artifact family: the serving tests run hardware- and
+    dataset-free on the synthetic LCLD schema (``synth_lcld_schema`` — the
+    same code-derived schema ``bench.py --serving`` falls back to)."""
+    from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld_schema
+
+    tmp = tmp_path_factory.mktemp("serving_artifacts")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(256, cons.schema, seed=5)
+    cons.check_constraints_error(x)  # the fixture must be constraint-valid
+
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=2))
+    save_params(sur, str(tmp / "nn.msgpack"))
+
+    from sklearn.preprocessing import MinMaxScaler
+    import joblib
+
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    scaler = MinMaxScaler().fit(np.vstack([x, xl, xu]))
+    joblib.dump(scaler, tmp / "scaler.joblib")
+    return {
+        "pool": x,
+        "domain": {
+            "project_name": "lcld",
+            "norm": 2,
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": paths["features"],
+                "constraints": paths["constraints"],
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "system": {"mesh_devices": 0},
+        },
+    }
+
+
+def make_service(artifacts, **kw):
+    kw.setdefault("bucket_sizes", (8, 16))
+    kw.setdefault("max_delay_s", 0.05)
+    kw.setdefault("max_queue_rows", 1024)
+    return AttackService({"lcld": artifacts["domain"]}, **kw)
+
+
+class TestServiceValidation:
+    def test_unknown_domain_and_family_and_shape(self, artifacts):
+        svc = make_service(artifacts, start=False)
+        from moeva2_ijcai22_replication_tpu.serving import InvalidRequest
+
+        pool = artifacts["pool"]
+        with pytest.raises(InvalidRequest, match="unknown domain"):
+            svc.submit(AttackRequest(domain="nope", x=pool[:2]))
+        with pytest.raises(InvalidRequest, match="attack family"):
+            svc.submit(AttackRequest(domain="lcld", x=pool[:2], attack="zap"))
+        with pytest.raises(InvalidRequest, match="MILP"):
+            svc.submit(
+                AttackRequest(domain="lcld", x=pool[:2], loss_evaluation="flip+sat")
+            )
+        with pytest.raises(InvalidRequest, match="features"):
+            svc.submit(AttackRequest(domain="lcld", x=pool[:2, :10]))
+        svc.close()
+
+
+class TestServingSmoke:
+    """Tier-1 acceptance: >= 64 concurrent mixed-size PGD requests through
+    the microbatcher, bit-identical to direct engine calls, with at most
+    one compiled program per (loss-strategy, bucket-size), and a populated
+    serving bench record."""
+
+    SIZES = [1, 2, 3, 5, 8, 13]  # mixed request sizes (6 distinct shapes)
+    STRATEGIES = ["flip", "constraints+flip"]
+    EPS = [0.2, 0.3]  # runtime ε: distinct batch keys, same executables
+
+    def _request(self, artifacts, i):
+        n = self.SIZES[i % len(self.SIZES)]
+        start = (i * 29) % (artifacts["pool"].shape[0] - n)
+        return AttackRequest(
+            domain="lcld",
+            x=artifacts["pool"][start : start + n],
+            attack="pgd",
+            loss_evaluation=self.STRATEGIES[i % 2],
+            eps=self.EPS[(i // 2) % 2],
+            budget=3,
+        )
+
+    def test_64_concurrent_requests_bit_identical_and_bounded_compiles(
+        self, artifacts
+    ):
+        svc = make_service(artifacts, max_delay_s=0.05)
+        n_requests = 64
+        reqs = [self._request(artifacts, i) for i in range(n_requests)]
+        with ThreadPoolExecutor(16) as pool:
+            resps = list(
+                pool.map(lambda r: svc.attack(r, timeout=300.0), reqs)
+            )
+        assert len(resps) == n_requests
+
+        # -- compile bound: at most one program per (strategy, bucket-size).
+        # ε and budget are runtime scalars, so the extra ε key must not add
+        # programs; bucket shapes used come from the response metadata.
+        buckets_used = {
+            (r.meta["loss_evaluation"], r.meta["bucket_size"]) for r in resps
+        }
+        compiles = svc.metrics.counters.get("compiles", 0)
+        assert 0 < compiles <= len(buckets_used), (
+            f"{compiles} compiled programs for {len(buckets_used)} "
+            f"(loss-strategy, bucket-size) pairs: {sorted(buckets_used)}"
+        )
+
+        # -- microbatching actually happened: fewer batches than requests
+        assert svc.metrics.counters["batches"] < n_requests
+        occ = [r.meta["batch_occupancy"] for r in resps]
+        assert all(0 < o <= 1 for o in occ)
+
+        # -- response metadata carries the execution mode
+        meta = resps[0].meta
+        assert meta["bit_identical"] is True
+        assert meta["execution"] == {
+            "max_states_per_call": None,
+            "mesh": None,
+            "bucket_menu": [8, 16],
+        }
+
+        # -- bit-identity: every request's rows match a direct engine call
+        # dispatched ALONE at the same bucket shape — coalescing with other
+        # requests and pad rows must change nothing, bit for bit
+        svc.close()  # drain; engines now free for direct dispatch
+        for req, resp in zip(reqs, resps):
+            direct = svc.execute_direct(req, bucket=resp.meta["bucket_size"])
+            np.testing.assert_array_equal(
+                resp.x_adv, direct,
+                err_msg=f"rows={req.x.shape[0]} le={req.loss_evaluation} "
+                        f"eps={req.eps} bucket={resp.meta['bucket_size']}",
+            )
+
+        # -- across shapes (request at its own un-bucketed shape) XLA may
+        # tile kernels differently; the engine-level drift stays tiny and
+        # the serving layer documents it rather than hiding it
+        for req, resp in list(zip(reqs, resps))[:2]:
+            own_shape = svc.execute_direct(req)
+            np.testing.assert_allclose(
+                resp.x_adv, own_shape, rtol=1e-5, atol=1e-3
+            )
+
+    def test_offered_load_sweep_record_populated(self, artifacts):
+        from moeva2_ijcai22_replication_tpu.serving.sweep import offered_load_sweep
+
+        svc = make_service(artifacts, max_delay_s=0.01)
+        # warm the two bucket shapes so the record measures steady serving
+        for n in (8, 16):
+            svc.attack(
+                AttackRequest(
+                    domain="lcld", x=artifacts["pool"][:n], eps=0.2, budget=3
+                ),
+                timeout=300.0,
+            )
+        record = offered_load_sweep(
+            svc,
+            lambda i: AttackRequest(
+                domain="lcld",
+                x=artifacts["pool"][: 1 + i % 8],
+                eps=0.2,
+                budget=3,
+            ),
+            offered_rps_levels=[200.0],
+            n_requests=32,
+        )
+        svc.close()
+        level = record["levels"][0]
+        assert level["completed"] == 32 and level["failed"] == 0
+        assert level["throughput_rps"] > 0
+        assert np.isfinite(level["p50_ms"]) and np.isfinite(level["p99_ms"])
+        assert level["p99_ms"] >= level["p50_ms"]
+        assert 0 < level["mean_batch_occupancy"] <= 1
+        assert record["batch_occupancy"]["count"] > 0
+        assert record["engine_cache"]["engines"] >= 1
+
+
+class TestServicePoisonIsolation:
+    def test_constraint_violating_request_fails_batch_not_service(
+        self, artifacts
+    ):
+        svc = make_service(artifacts, start=False, clock=FakeClock())
+        clock = svc.clock
+        pool = artifacts["pool"]
+        poison = pool[:2].copy()
+        poison[:, 0] = 1e9  # breaks the installment/loan-amount constraint
+        good_req = AttackRequest(domain="lcld", x=pool[:3], eps=0.2, budget=2)
+        f_good = svc.submit(good_req)
+        f_poison = svc.submit(
+            AttackRequest(domain="lcld", x=poison, eps=0.2, budget=2)
+        )
+        clock.advance(0.1)
+        svc.batcher.flush_due()
+        # same batch key -> the poison fails its batch-mates too
+        for f in (f_good, f_poison):
+            with pytest.raises(BatchExecutionError):
+                f.result(timeout=0)
+        assert svc.metrics.counters["batch_failures"] == 1
+        # the service survives: a clean retry succeeds
+        f_retry = svc.submit(good_req)
+        clock.advance(0.1)
+        svc.batcher.flush_due()
+        x_adv, meta = f_retry.result(timeout=0)
+        assert x_adv.shape == (3, pool.shape[1])
+        svc.close()
+
+
+@pytest.mark.slow
+class TestHTTPEndToEnd:
+    def test_server_and_loadgen(self, artifacts, tmp_path):
+        import yaml
+
+        from moeva2_ijcai22_replication_tpu.serving.server import serve
+
+        svc = make_service(artifacts, max_delay_s=0.02)
+        httpd = serve(svc, "127.0.0.1", 0, request_timeout_s=300.0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{port}"
+        try:
+            # healthz + metrics
+            with urllib.request.urlopen(f"{url}/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["ok"] and health["domains"] == ["lcld"]
+
+            # one real attack over the wire
+            rows = artifacts["pool"][:3].tolist()
+            body = json.dumps(
+                {"domain": "lcld", "rows": rows, "eps": 0.2, "budget": 2}
+            ).encode()
+            req = urllib.request.Request(
+                f"{url}/attack", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                resp = json.loads(r.read())
+            assert np.asarray(resp["x_adv"]).shape == (3, 47)
+            assert resp["meta"]["bucket_size"] == 8
+
+            # error mapping: unknown domain -> 400
+            bad = urllib.request.Request(
+                f"{url}/attack",
+                data=json.dumps({"domain": "nope", "rows": rows}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=10)
+            assert ei.value.code == 400
+            ei.value.read()
+
+            with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+                snap = json.loads(r.read())
+            assert snap["counters"]["completed"] >= 1
+
+            # loadgen end-to-end (subprocess, the documented quickstart path)
+            import subprocess
+            import sys as _sys
+            import os as _os
+
+            cfg_path = tmp_path / "serving.yaml"
+            cfg_path.write_text(
+                yaml.dump({"domains": {"lcld": artifacts["domain"]}})
+            )
+            repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+            out = subprocess.run(
+                [
+                    _sys.executable, _os.path.join(repo, "tools", "loadgen.py"),
+                    "--url", url, "--config", str(cfg_path),
+                    "--requests", "8", "--concurrency", "4",
+                    "--rows-min", "1", "--rows-max", "4",
+                    "--eps", "0.2", "--budget", "2",
+                ],
+                capture_output=True, text=True, timeout=600,
+                env=dict(_os.environ, JAX_PLATFORMS="cpu"),
+            )
+            assert out.returncode == 0, out.stderr[-500:]
+            summary = json.loads(out.stdout.strip().splitlines()[-1])
+            assert summary["statuses"].get("ok") == 8
+            assert summary["throughput_rps"] > 0
+        finally:
+            httpd.shutdown()
+            svc.close()
+
+
+@pytest.mark.slow
+class TestMoevaServing:
+    def test_moeva_request_round_trip(self, artifacts):
+        svc = make_service(artifacts, max_delay_s=0.02)
+        resp = svc.attack(
+            AttackRequest(
+                domain="lcld",
+                x=artifacts["pool"][:3],
+                attack="moeva",
+                budget=2,
+                params={"n_pop": 16, "n_offsprings": 8},
+            ),
+            timeout=600.0,
+        )
+        # (rows, population, features) — the runner's x_attacks layout
+        assert resp.x_adv.shape[0] == 3 and resp.x_adv.ndim == 3
+        assert resp.x_adv.shape[2] == 47
+        # batch-shape-keyed RNG: explicitly NOT bit-identical across shapes
+        assert resp.meta["bit_identical"] is False
+        svc.close()

@@ -1,0 +1,149 @@
+"""HTTP load generator for the attack service (tools/serve.py).
+
+Open-loop offered load against ``POST /attack``: N requests with
+mixed-size constraint-valid synthetic rows, paced at ``--rps`` (0 = as
+fast as the concurrency allows), issued from a thread pool. Prints one
+JSON summary line: achieved throughput, latency quantiles, and the
+status breakdown (ok / rejected-429 / timeout-504 / error) — the
+client-side mirror of the server's ``/metrics`` record.
+
+    python tools/loadgen.py --url http://127.0.0.1:8787 --domain lcld \
+        --requests 64 --concurrency 8 --rows-min 1 --rows-max 13
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moeva2_ijcai22_replication_tpu.utils.observability import percentile  # noqa: E402
+
+
+def make_rows(domain_cfg: dict, n_rows: int, seed: int):
+    """Constraint-valid candidate rows for the domain: synthesized for LCLD
+    (no redistributable candidate set), sampled from the committed candidate
+    set otherwise (e.g. the 387-row botnet set)."""
+    project = domain_cfg["project_name"]
+    if project.startswith("lcld"):
+        from moeva2_ijcai22_replication_tpu.domains import get_constraints_class
+        from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+
+        cons = get_constraints_class(project)(
+            domain_cfg["paths"]["features"], domain_cfg["paths"]["constraints"]
+        )
+        return synth_lcld(n_rows, cons.schema, seed=seed).tolist()
+    import numpy as np
+
+    path = domain_cfg["paths"].get(
+        "x_candidates", "/root/reference/data/botnet/x_candidates_common.npy"
+    )
+    x = np.load(path)
+    idx = np.random.default_rng(seed).integers(0, x.shape[0], size=n_rows)
+    return x[idx].tolist()
+
+
+def post_attack(url: str, payload: dict, timeout: float):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"{url}/attack", data=body, headers={"Content-Type": "application/json"}
+    )
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            json.loads(resp.read())
+            return "ok", time.monotonic() - t0
+    except urllib.error.HTTPError as e:
+        code = e.code
+        e.read()
+        status = {429: "rejected", 504: "timeout"}.get(code, f"http_{code}")
+        return status, time.monotonic() - t0
+    except Exception as e:  # noqa: BLE001 — loadgen counts, not raises
+        return f"error:{type(e).__name__}", time.monotonic() - t0
+
+
+def run(args) -> dict:
+    from moeva2_ijcai22_replication_tpu.utils.config import load_config_file
+
+    domain_cfg = load_config_file(args.config)["domains"][args.domain]
+    sizes = [
+        args.rows_min + i % (args.rows_max - args.rows_min + 1)
+        for i in range(args.requests)
+    ]
+    rows_cache = {
+        n: make_rows(domain_cfg, n, seed=1000 + n) for n in sorted(set(sizes))
+    }
+
+    def one(i: int):
+        payload = {
+            "domain": args.domain,
+            "rows": rows_cache[sizes[i]],
+            "eps": args.eps,
+            "budget": args.budget,
+            "loss_evaluation": args.loss_evaluation,
+            "request_id": f"loadgen-{i}",
+        }
+        return post_attack(args.url, payload, args.timeout)
+
+    period = 1.0 / args.rps if args.rps > 0 else 0.0
+    t_start = time.monotonic()
+    results = []
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        futs = []
+        for i in range(args.requests):
+            target = t_start + i * period
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(pool.submit(one, i))
+        results = [f.result() for f in futs]
+    duration = max(time.monotonic() - t_start, 1e-9)
+
+    statuses: dict[str, int] = {}
+    for status, _ in results:
+        statuses[status] = statuses.get(status, 0) + 1
+    ok_lat = sorted(dt for status, dt in results if status == "ok")
+    return {
+        "url": args.url,
+        "domain": args.domain,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "offered_rps": args.rps,
+        "duration_s": round(duration, 3),
+        "throughput_rps": round(len(ok_lat) / duration, 2),
+        "p50_ms": round(percentile(ok_lat, 0.50) * 1e3, 2) if ok_lat else None,
+        "p99_ms": round(percentile(ok_lat, 0.99) * 1e3, 2) if ok_lat else None,
+        "statuses": statuses,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--url", default="http://127.0.0.1:8787")
+    parser.add_argument("--config", default="config/serving.yaml",
+                        help="serving config (for domain artifact paths)")
+    parser.add_argument("--domain", default="lcld")
+    parser.add_argument("--requests", type=int, default=64)
+    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument("--rps", type=float, default=0.0,
+                        help="offered request rate; 0 = unpaced")
+    parser.add_argument("--rows-min", type=int, default=1)
+    parser.add_argument("--rows-max", type=int, default=13)
+    parser.add_argument("--eps", type=float, default=0.2)
+    parser.add_argument("--budget", type=int, default=10)
+    parser.add_argument("--loss-evaluation", default="flip")
+    parser.add_argument("--timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+    print(json.dumps(run(args)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

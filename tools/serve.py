@@ -1,0 +1,79 @@
+"""Run the attack service behind its stdlib HTTP front.
+
+Quickstart (after ``python tools/bootstrap_lcld.py`` for the LCLD domain):
+
+    python tools/serve.py -c config/serving.yaml
+    python tools/loadgen.py --url http://127.0.0.1:8787 --domain lcld \
+        --requests 64 --concurrency 8
+
+Then::
+
+    curl -s localhost:8787/healthz
+    curl -s localhost:8787/metrics
+    curl -s -X POST localhost:8787/attack -d '{"domain": "lcld",
+        "eps": 0.2, "budget": 10, "rows": [[...47 features...]]}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "-c", default="config/serving.yaml", help="serving config yaml"
+    )
+    parser.add_argument("--host", default=None, help="override serving.host")
+    parser.add_argument("--port", type=int, default=None, help="override serving.port")
+    parser.add_argument("-v", "--verbose", action="store_true", help="access log")
+    args = parser.parse_args(argv)
+
+    from moeva2_ijcai22_replication_tpu.experiments.common import setup_jax_cache
+    from moeva2_ijcai22_replication_tpu.serving import AttackService
+    from moeva2_ijcai22_replication_tpu.serving.server import serve
+    from moeva2_ijcai22_replication_tpu.utils.config import load_config_file
+
+    cfg = load_config_file(args.c)
+    srv_cfg = cfg.get("serving", {})
+    setup_jax_cache(cfg)
+
+    service = AttackService(
+        cfg["domains"],
+        bucket_sizes=srv_cfg.get("bucket_sizes", (8, 16, 32, 64, 128, 256)),
+        max_delay_s=srv_cfg.get("max_delay_s", 0.01),
+        max_queue_rows=srv_cfg.get("max_queue_rows", 4096),
+        seed=srv_cfg.get("seed", 42),
+    )
+    host = args.host or srv_cfg.get("host", "127.0.0.1")
+    port = args.port if args.port is not None else srv_cfg.get("port", 8787)
+    httpd = serve(
+        service,
+        host,
+        port,
+        request_timeout_s=srv_cfg.get("request_timeout_s", 60.0),
+        verbose=args.verbose,
+    )
+    bound = httpd.server_address
+    print(
+        f"attack service on http://{bound[0]}:{bound[1]} "
+        f"(domains: {', '.join(sorted(cfg['domains']))}; "
+        f"buckets {list(service.menu.sizes)})",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        service.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,6 +264,120 @@ def run_real_botnet() -> dict | None:
         return None
 
 
+def run_early_exit_bench() -> dict | None:
+    """Success-gated early exit A/B (the ``early_exit`` record): one engine,
+    one seed, one candidate set — a fixed-budget strict run vs an early-exit
+    run (``early_stop_check_every``) on the code-derived synthetic LCLD
+    schema, so the record reproduces in any CI container with no reference
+    tree. The scenario is the serving layer's "easy rows" case: candidates
+    are picked near the decision boundary so most states hold a constrained
+    adversarial well before half the budget — exactly the population the
+    round-5 adjudication measured (success 0.959 by gen 300 of 1000). The
+    record carries wall-clock for both modes (min-of-2 steady), generations
+    executed vs budget, the compaction trace, the distinct compiled segment
+    programs of the shrinking run (bounded by the bucket-menu length), and
+    the criterion success rates of both runs (archive on, so early exit
+    cannot lose successes). ``BENCH_SKIP_EARLY_EXIT=1`` skips;
+    BENCH_EE_STATES / _GENS / _CHECK / _POP / _OFF reshape the run."""
+    if os.environ.get("BENCH_SKIP_EARLY_EXIT"):
+        return None
+    try:
+        import tempfile
+
+        import jax.numpy as jnp
+
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+        from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+        from moeva2_ijcai22_replication_tpu.domains.synth import (
+            synth_lcld,
+            synth_lcld_schema,
+        )
+        from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+        from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+        from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+        s = int(os.environ.get("BENCH_EE_STATES", 64))
+        n_gen = int(os.environ.get("BENCH_EE_GENS", 201))  # 200 scan steps
+        check = int(os.environ.get("BENCH_EE_CHECK", 10))
+        n_pop = int(os.environ.get("BENCH_EE_POP", 40))
+        n_off = int(os.environ.get("BENCH_EE_OFF", 20))
+        threshold = 0.5
+
+        tmp = tempfile.mkdtemp(prefix="bench_early_exit_")
+        paths = synth_lcld_schema(tmp)
+        cons = LcldConstraints(paths["features"], paths["constraints"])
+        model = lcld_mlp()
+        sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+
+        # easy-rows candidate selection: states already near (or past) the
+        # boundary converge early — the workload the gate exists for
+        pool = synth_lcld(8 * s, cons.schema, seed=7)
+        scaler = fit_minmax(pool.min(0), pool.max(0))
+        p1 = np.asarray(sur.predict_proba(scaler.transform(pool)))[:, 1]
+        x = pool[np.argsort(np.abs(p1 - threshold))[:s]]
+
+        moeva = Moeva2(
+            classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
+            n_gen=n_gen, n_pop=n_pop, n_offsprings=n_off, seed=42,
+            archive_size=8, early_stop_threshold=threshold,
+        )
+
+        def timed(check_every):
+            moeva.early_stop_check_every = check_every
+            best, res = None, None
+            for _ in range(2):  # min-of-2: first call may include compiles
+                t0 = time.time()
+                res = moeva.generate(x, minimize_class=1)
+                dt = time.time() - t0
+                best = dt if best is None else min(best, dt)
+            return best, res
+
+        def success(res):
+            f = res.f
+            return float(
+                ((f[..., 0] < threshold) & (f[..., 2] <= 0)).any(axis=1).mean()
+            )
+
+        fixed_s, fixed = timed(0)
+        traces0 = moeva.trace_count
+        early_s, early = timed(check)
+        seg_programs = moeva.trace_count - traces0
+        trace = early.early_stop["compaction"]
+        # states already solved by the last gate at or before half budget
+        converged_half = 0
+        for t in trace:
+            if t["gen"] <= (n_gen - 1) // 2:
+                converged_half = s - t["active"]
+        menu_len = len(moeva._compaction_menu().sizes)
+        record = {
+            "n_states": s,
+            "budget_gens": n_gen - 1,
+            "check_every": check,
+            "steady_estimator": "min2",
+            "fixed_s": round(fixed_s, 3),
+            "early_s": round(early_s, 3),
+            "speedup": round(fixed_s / early_s, 2),
+            "gens_executed": int(early.gens_executed),
+            "converged_by_half_budget": round(converged_half / s, 3),
+            "compaction": trace,
+            "distinct_segment_programs": int(seg_programs),
+            "bucket_menu_len": menu_len,
+            "success_fixed": round(success(fixed), 4),
+            "success_early": round(success(early), 4),
+        }
+        log(
+            f"[bench] early_exit: fixed {fixed_s:.2f}s vs early {early_s:.2f}s "
+            f"({record['speedup']}x), gens {early.gens_executed}/{n_gen - 1}, "
+            f"{seg_programs} segment programs (menu {menu_len}), success "
+            f"{record['success_fixed']} -> {record['success_early']}, "
+            f"{record['converged_by_half_budget']:.0%} converged by half budget"
+        )
+        return record
+    except Exception as e:
+        log(f"[bench] early-exit metric skipped: {e}")
+        return None
+
+
 def run_serving_bench() -> dict | None:
     """Request-path metric (no network, single process, CPU-able — the CI
     mode behind ``bench.py --serving``): an offered-load sweep of mixed-size
@@ -402,6 +516,13 @@ def main():
         print(json.dumps({"metric": "serving_offered_load_sweep", "serving": rec}))
         return
 
+    # --early-exit: ONLY the success-gated early-exit A/B — synthetic
+    # schema, one process, CPU-able; the CI-reproducible early_exit record.
+    if "--early-exit" in sys.argv:
+        rec = run_early_exit_bench()
+        print(json.dumps({"metric": "moeva_early_exit_ab", "early_exit": rec}))
+        return
+
     # Whole-grid wallclock FIRST: its subprocesses need the (exclusive) TPU,
     # so it must run before this process initialises the backend below.
     grid = measure_grid_wallclock()
@@ -513,6 +634,7 @@ def main():
 
     real_botnet = run_real_botnet()
     serving = run_serving_bench()
+    early_exit = run_early_exit_bench()
 
     t_measured = measure_ref_pergen()
     t_pergen = min(t_measured, FALLBACK_REF_PERGEN_S)
@@ -542,6 +664,8 @@ def main():
         record["real_botnet"] = real_botnet
     if serving:
         record["serving"] = serving
+    if early_exit:
+        record["early_exit"] = early_exit
     if grid:
         record["grid_wallclock"] = grid
         # headline key only from a CLEAN warm pass (rc 0, metrics produced) —

@@ -10,6 +10,10 @@ Design: the evolution carry (populations, objectives, elite archive,
 normalisation memory, PRNG key) is a pytree of device arrays that fully
 determines the remaining computation — the PRNG key continues the exact
 random stream, so a resumed attack is bit-identical to an uninterrupted one.
+Early-exit runs add host state the carry alone cannot express — the
+active-set mapping (which original row each compacted carry row tracks) and
+the parked results of already solved states — saved as an ``extra`` sidecar
+inside the same ``.npz``.
 At each ``checkpoint_every``-generation boundary the carry is fetched and
 written atomically (tmp + rename) to one ``.npz``; per-segment history
 records stream to sidecar files as they are offloaded, so resume also
@@ -39,10 +43,21 @@ class AttackCheckpointer:
         self.path = path
         self.fingerprint = fingerprint
         self.hist_dir = path + ".hist"
+        #: host-state sidecar of the last successful :meth:`load` — e.g. the
+        #: early-exit active-set mapping + parked results (None when the
+        #: snapshot carried none).
+        self.extra: dict | None = None
 
     # -- carry snapshots ----------------------------------------------------
-    def save(self, carry, done: int, n_hist: int) -> None:
-        """Atomically persist the carry after ``done`` generation steps."""
+    def save(self, carry, done: int, n_hist: int, extra: dict | None = None) -> None:
+        """Atomically persist the carry after ``done`` generation steps.
+
+        ``extra`` is an optional dict of host-side numpy arrays saved (and
+        restored) alongside the carry — the engine uses it for the
+        early-exit active-set mapping, without which a compacted carry
+        could not be resumed (its states axis no longer matches the
+        attack's inputs row-for-row).
+        """
         leaves, _ = jax.tree_util.tree_flatten(carry)
         leaves = jax.device_get(leaves)
         meta = json.dumps(
@@ -51,6 +66,7 @@ class AttackCheckpointer:
                 "done": int(done),
                 "n_leaves": len(leaves),
                 "n_hist": int(n_hist),
+                "extra_keys": sorted(extra) if extra else [],
             }
         )
         tmp = self.path + ".tmp"
@@ -58,6 +74,7 @@ class AttackCheckpointer:
             np.savez(
                 fh,
                 **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+                **{f"extra_{k}": np.asarray(v) for k, v in (extra or {}).items()},
                 **{_META: np.asarray(meta)},
             )
         os.replace(tmp, self.path)
@@ -78,6 +95,12 @@ class AttackCheckpointer:
                 if meta.get("fingerprint") != self.fingerprint:
                     return None
                 leaves = [z[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+                extra_keys = meta.get("extra_keys") or []
+                self.extra = (
+                    {k: z[f"extra_{k}"] for k in extra_keys}
+                    if extra_keys
+                    else None
+                )
         except Exception:
             return None  # truncated/corrupt file: start fresh
         tmpl_leaves, treedef = jax.tree_util.tree_flatten(carry_template)

@@ -55,6 +55,47 @@ class MoevaResult:
     #: entry 0 = initial population (S, P, C), then one (S, n_off, C) per
     #: generation; C = 3 for "reduced", 3 + n_constraints for "full".
     history: list | None = None
+    #: generation steps actually executed on device — ``n_gen - 1`` per state
+    #: chunk (summed across chunks) unless the success gate exited early.
+    gens_executed: int = 0
+    #: early-exit observability (None in strict mode): ``{"check_every",
+    #: "gens_executed", "budget_gens", "compaction": [{"gen", "active",
+    #: "bucket"(, "chunk")}, ...]}`` — the compaction trace records every
+    #: gate at which states parked (``bucket`` = the post-gate dispatch
+    #: shape, so a repack shows as a shrink) and the full early exit as
+    #: ``active: 0``.
+    early_stop: dict | None = None
+
+
+@dataclass
+class _InFlightRun:
+    """A fully dispatched attack whose results have not been fetched.
+
+    ``_launch_one`` enqueues every segment (syncing only on the tiny
+    early-exit masks) and returns this; ``_finalize_one`` performs the
+    device→host fetch, the parked/active merge, and the ML decode. The
+    split lets ``_generate_chunked`` fetch chunk *i*'s results while chunk
+    *i+1*'s dispatch is already executing — the same one-dispatch-late
+    pattern the history ``pending`` buffer uses.
+    """
+
+    x: np.ndarray
+    t0: float
+    carry: tuple
+    #: original row index each current carry row tracks (pads duplicate a
+    #: live row's index) and whether the row's final result is wanted.
+    row_src: np.ndarray
+    row_live: np.ndarray
+    #: host-frozen final populations of solved states: {"mask", "x", "f"}.
+    parked: dict | None
+    check: int
+    n_steps: int
+    gens_executed: int
+    trace: list
+    init_hist: Any
+    hist_chunks: list
+    pending: Any
+    cp: Any
 
 
 @dataclass
@@ -121,6 +162,41 @@ class Moeva2:
     #: sidesteps the worker-fault program-size band documented in
     #: docs/DESIGN.md §3. None = one batch.
     max_states_per_call: int | None = None
+    #: success-gated early exit (0 = strict mode, the default: bit-identical
+    #: to a run without the knob). Every ``early_stop_check_every``
+    #: generations the scan pauses at a segment boundary and fetches a tiny
+    #: on-device (S,) success mask — the ObjectiveCalculator criterion
+    #: (misclassified ∧ Σ violations = 0 ∧ within ``early_stop_eps``)
+    #: evaluated over the population ∪ archive objectives. Solved states are
+    #: parked (their populations frozen on host) and the surviving active
+    #: set is repacked down the shared power-of-two bucket menu
+    #: (``experiments.common.DEFAULT_BUCKET_SIZES``), so a shrinking run
+    #: dispatches at most one extra executable per menu size; when every
+    #: state is solved the remaining budget is skipped entirely. RNG caveat:
+    #: compaction changes the states-batch shape mid-run and therefore the
+    #: per-generation random draws, exactly like ``max_states_per_call``
+    #: chunking — strict mode stays available for parity runs. With
+    #: ``archive_size > 0`` the criterion is monotone (a success, once in
+    #: the archive, cannot be lost), so early-stopped success rates are >=
+    #: the fixed-budget run's; parking preserves the observed success even
+    #: without an archive. Incompatible with ``save_history`` (history
+    #: records are not reassembled across repacks). Prefer a value dividing
+    #: ``n_gen - 1`` so all segments share one compiled length.
+    early_stop_check_every: int = 0
+    #: misclassification-probability threshold of the success criterion
+    #: (the runner plumbs ``misclassification_threshold`` here).
+    early_stop_threshold: float = 0.5
+    #: distance bound of the success criterion, in the engine's min-max
+    #: normalised feature space (before the L2 sqrt(D) objective scaling).
+    #: inf (default) judges misclassified ∧ feasible only — the engine's
+    #: per-state normalisation differs from the global scaler the post-hoc
+    #: ObjectiveCalculator uses, so a finite ε here is a gate on the
+    #: engine's own objective, not the exact o7 judgement.
+    early_stop_eps: float = float("inf")
+    #: compaction bucket sizes; None = the shared serving/batcher menu
+    #: (``experiments.common.DEFAULT_BUCKET_SIZES``). Sizes not divisible by
+    #: the mesh size are skipped (states-axis sharding contract).
+    compaction_buckets: tuple | None = None
     dtype: Any = jnp.float32
     mesh: jax.sharding.Mesh | None = None
     states_axis: str = "states"
@@ -154,6 +230,7 @@ class Moeva2:
             )
         self._jit_init = None
         self._jit_segment = None
+        self._jit_success = None
         #: number of program (re)traces across init + segment — one per
         #: distinct executable (grid observability reads the delta per point).
         self.trace_count = 0
@@ -356,7 +433,8 @@ class Moeva2:
         """The states-chunk size :meth:`generate` actually dispatches with:
         ``max_states_per_call`` rounded DOWN to a mesh-size multiple (never
         up — the configured chunk is a device-memory / program-size ceiling),
-        e.g. the 500 default on an 8-device mesh runs as 496. Chunking folds
+        e.g. a configured 500 on an 8-device mesh runs as 496 (the shipped
+        ``config/moeva.yaml`` default of 256 is already aligned). Chunking folds
         per-chunk RNG keys, so runners record this value in the metrics to
         keep every committed number's execution mode traceable."""
         chunk = self.max_states_per_call
@@ -393,11 +471,35 @@ class Moeva2:
         """Sequential chunks of one compiled program; the tail chunk is
         padded (states are independent, the pad rows are trimmed) so every
         dispatch reuses the same executable. Chunk keys are folds of the
-        seed key, so chunks draw independent random streams."""
+        seed key, so chunks draw independent random streams.
+
+        Host/device overlap: chunk *i*'s results are fetched one dispatch
+        late — after chunk *i+1*'s segments are enqueued — so the fetch,
+        the parked/active merge, and the host-side ML decode run while the
+        device executes the next chunk (the history ``pending`` pattern
+        applied to the final populations)."""
         t0 = time.time()
         s = x.shape[0]
         base_key = jax.random.PRNGKey(self.seed)
         parts: list[MoevaResult] = []
+        prev: tuple[_InFlightRun, int] | None = None
+
+        def finalize(run: _InFlightRun, n_real: int) -> MoevaResult:
+            res = self._finalize_one(run)
+            return MoevaResult(
+                x_gen=res.x_gen[:n_real],
+                f=res.f[:n_real],
+                x_ml=res.x_ml[:n_real],
+                x_initial=res.x_initial[:n_real],
+                n_gen=res.n_gen,
+                time=res.time,
+                history=None
+                if res.history is None
+                else [h[:n_real] for h in res.history],
+                gens_executed=res.gens_executed,
+                early_stop=res.early_stop,
+            )
+
         for i, start in enumerate(range(0, s, chunk)):
             xc = x[start : start + chunk]
             mc = minimize_class[start : start + chunk]
@@ -409,27 +511,31 @@ class Moeva2:
             cp_path = (
                 f"{self.checkpoint_path}.chunk{i}" if self.checkpoint_path else None
             )
-            res = self._generate_one(
+            run = self._launch_one(
                 xc, mc, jax.random.fold_in(base_key, i), cp_path
             )
-            parts.append(
-                MoevaResult(
-                    x_gen=res.x_gen[:n_real],
-                    f=res.f[:n_real],
-                    x_ml=res.x_ml[:n_real],
-                    x_initial=res.x_initial[:n_real],
-                    n_gen=res.n_gen,
-                    time=res.time,
-                    history=None
-                    if res.history is None
-                    else [h[:n_real] for h in res.history],
-                )
-            )
+            if prev is not None:
+                parts.append(finalize(*prev))
+            prev = (run, n_real)
+        parts.append(finalize(*prev))
         history = None
         if parts[0].history is not None:
             history = [
                 np.concatenate(hs, axis=0) for hs in zip(*(p.history for p in parts))
             ]
+        gens_executed = sum(p.gens_executed for p in parts)
+        early_stop = None
+        if parts[0].early_stop is not None:
+            early_stop = {
+                "check_every": parts[0].early_stop["check_every"],
+                "gens_executed": gens_executed,
+                "budget_gens": (self.n_gen - 1) * len(parts),
+                "compaction": [
+                    dict(t, chunk=i)
+                    for i, p in enumerate(parts)
+                    for t in p.early_stop["compaction"]
+                ],
+            }
         return MoevaResult(
             x_gen=np.concatenate([p.x_gen for p in parts], axis=0),
             f=np.concatenate([p.f for p in parts], axis=0),
@@ -438,6 +544,8 @@ class Moeva2:
             n_gen=self.n_gen,
             time=time.time() - t0,
             history=history,
+            gens_executed=gens_executed,
+            early_stop=early_stop,
         )
 
     def _generate_one(
@@ -447,14 +555,168 @@ class Moeva2:
         key: jax.Array,
         checkpoint_path: str | None,
     ) -> MoevaResult:
+        return self._finalize_one(
+            self._launch_one(x, minimize_class, key, checkpoint_path)
+        )
+
+    # -- early-exit machinery ------------------------------------------------
+    def _compaction_menu(self):
+        """The shared fixed-shape dispatch menu, filtered to mesh-aligned
+        sizes — ONE source of truth with the serving microbatcher."""
+        from ...experiments.common import DEFAULT_BUCKET_SIZES, BucketMenu
+
+        sizes = tuple(self.compaction_buckets or DEFAULT_BUCKET_SIZES)
+        if self.mesh is not None:
+            sizes = tuple(b for b in sizes if b % self.mesh.size == 0)
+        return BucketMenu(sizes) if sizes else None
+
+    def _success_mask(self, carry):
+        """(S,) on-device success mask from the carried objectives: the
+        ObjectiveCalculator criterion (misclassified ∧ Σ violations = 0 ∧
+        within ε) over population ∪ archive. A tiny program whose output is
+        the only device→host traffic between early-exit segments."""
+
+        if self._jit_success is None:
+
+            def success_mask(pop_f, arch_f, thr, eps):
+                f = (
+                    jnp.concatenate([pop_f, arch_f], axis=1)
+                    if arch_f.shape[1]
+                    else pop_f
+                )
+                ok = (f[..., 0] < thr) & (f[..., 2] <= 0.0) & (f[..., 1] <= eps)
+                return ok.any(axis=1)
+
+            self._jit_success = jax.jit(success_mask)
+        # early_stop_eps is a distance in normalised feature space; the
+        # carried f2 objective divides L2 distances by sqrt(D)
+        eps = float(self.early_stop_eps) / self._f2_scale
+        return self._jit_success(
+            carry[1],
+            carry[3],
+            jnp.asarray(self.early_stop_threshold, self.dtype),
+            jnp.asarray(eps, self.dtype),
+        )
+
+    def _take_carry(self, carry, sel: np.ndarray):
+        """Repack the carry's states axis to ``sel`` (device-side gather —
+        the populations never round-trip through host memory)."""
+        pop_x, pop_f, arch_x, arch_f, norm_state, key = carry
+        sel_dev = jnp.asarray(sel)
+        take = lambda a: jnp.take(a, sel_dev, axis=0)  # noqa: E731
+        out = (
+            take(pop_x),
+            take(pop_f),
+            take(arch_x),
+            take(arch_f),
+            jax.tree.map(take, norm_state),
+            key,
+        )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh = NamedSharding(self.mesh, PartitionSpec(self.states_axis))
+            out = (
+                *(jax.device_put(a, sh) for a in out[:4]),
+                jax.tree.map(lambda a: jax.device_put(a, sh), out[4]),
+                key,
+            )
+        return out
+
+    def _final_columns(self, carry, idx: np.ndarray):
+        """Rows ``idx``'s returned-population columns (pop + archive)."""
+        pop_x, pop_f, arch_x, arch_f = carry[0], carry[1], carry[2], carry[3]
+        sel = jnp.asarray(idx)
+        px = jnp.take(pop_x, sel, axis=0)
+        pf = jnp.take(pop_f, sel, axis=0)
+        if self.archive_size:
+            px = jnp.concatenate([px, jnp.take(arch_x, sel, axis=0)], axis=1)
+            pf = jnp.concatenate([pf, jnp.take(arch_f, sel, axis=0)], axis=1)
+        return px, pf
+
+    def _place_rows(self, x, minimize_class, xl_ml, xu_ml, rows: np.ndarray):
+        """Device placement of the per-state attack inputs for the current
+        active set (``rows`` = original row indices, pads duplicated)."""
+        arrs = (
+            jnp.asarray(x[rows], self.dtype),
+            jnp.asarray(minimize_class[rows], jnp.int32),
+            jnp.asarray(xl_ml[rows], self.dtype),
+            jnp.asarray(xu_ml[rows], self.dtype),
+        )
+        if self.mesh is not None:
+            from ..sharding import shard_states_args
+
+            _, arrs = shard_states_args(
+                self.mesh, self.states_axis, (), arrs
+            )
+        return arrs
+
+    @staticmethod
+    def _early_stop_extra(s, row_src, row_live, parked, gens_executed, trace):
+        """Checkpoint payload of the early-exit host state: the active-set
+        mapping plus the parked final populations — a resumed compacted run
+        must rebuild its (shrunken) dispatch arguments and keep the already
+        solved states' results."""
+        import json
+
+        if parked is None:
+            parked_mask = np.zeros(s, dtype=bool)
+            parked_x = np.zeros((s, 0, 0))
+            parked_f = np.zeros((s, 0, 0))
+        else:
+            parked_mask, parked_x, parked_f = (
+                parked["mask"], parked["x"], parked["f"]
+            )
+        return {
+            "row_src": np.asarray(row_src),
+            "row_live": np.asarray(row_live),
+            "parked_mask": parked_mask,
+            "parked_x": parked_x,
+            "parked_f": parked_f,
+            "state_json": np.asarray(
+                json.dumps(
+                    {
+                        "gens_executed": int(gens_executed),
+                        "trace": trace,
+                        "parked": parked is not None,
+                    }
+                )
+            ),
+        }
+
+    # -- dispatch ------------------------------------------------------------
+    def _launch_one(
+        self,
+        x: np.ndarray,
+        minimize_class: np.ndarray,
+        key: jax.Array,
+        checkpoint_path: str | None,
+    ) -> _InFlightRun:
+        s = x.shape[0]
+        check = int(self.early_stop_check_every or 0)
+        if check and self.save_history:
+            raise ValueError(
+                "early_stop_check_every is incompatible with save_history: "
+                "active-set compaction changes the states axis mid-run and "
+                "per-generation history records are not reassembled across "
+                "repacks (run strict mode for history)"
+            )
         xl_ml, xu_ml = self.constraints.get_feature_min_max(dynamic_input=x)
         xl_ml = np.broadcast_to(np.asarray(xl_ml, dtype=np.float64), x.shape)
         xu_ml = np.broadcast_to(np.asarray(xu_ml, dtype=np.float64), x.shape)
 
         if self._jit_init is None:
             self._jit_init = jax.jit(self._build_init())
+            # Donate the evolution carry: without donation every chained
+            # segment holds TWO full population copies in HBM (the consumed
+            # input and the produced output); with it XLA reuses the buffers
+            # in place. Host code never touches a carry after re-dispatching
+            # it (checkpoint saves and mask fetches read the *output* carry
+            # before the next dispatch consumes it).
             self._jit_segment = jax.jit(
-                self._build_segment(), static_argnames="length"
+                self._build_segment(),
+                static_argnames="length",
+                donate_argnums=(5,),
             )
 
         args = (
@@ -481,21 +743,61 @@ class Moeva2:
         t0 = time.time()
         carry, init_hist = self._jit_init(*args)
         n_steps = self.n_gen - 1
-        # Without history a single segment reproduces the one-scan program;
-        # with history, fixed-size segments bound HBM usage and each chunk's
-        # records move to host while the next segment runs. Checkpoint
-        # boundaries cap segment length so saves land exactly on multiples
-        # of ``checkpoint_every``.
+        # Without history or early exit a single segment reproduces the
+        # one-scan program; with history, fixed-size segments bound HBM
+        # usage and each chunk's records move to host while the next segment
+        # runs; with early exit, segments end on ``check`` boundaries so the
+        # success mask can gate the next dispatch. Checkpoint boundaries cap
+        # segment length so saves land exactly on ``checkpoint_every``
+        # multiples.
         chunk = n_steps if not self.save_history else max(1, self.history_chunk)
+        if check:
+            chunk = max(1, min(chunk, check))
         hist_chunks = []
         pending = None  # previous chunk's device buffer, fetched one dispatch late
         done = 0
+        # early-exit host state: which original row each carry row tracks,
+        # whether its final result is still wanted, and the frozen results
+        # of already solved rows
+        row_src = np.arange(s)
+        row_live = np.ones(s, dtype=bool)
+        parked: dict | None = None
+        trace: list = []
+        gens_executed = 0
         if cp is not None:
             resumed = cp.load(carry)
             if resumed is not None:
                 carry, done, hist_chunks = resumed
+                gens_executed = done
+                extra = cp.extra
+                if extra is not None:
+                    import json
+
+                    row_src = np.asarray(extra["row_src"])
+                    row_live = np.asarray(extra["row_live"]).astype(bool)
+                    state = json.loads(str(extra["state_json"]))
+                    gens_executed = int(state["gens_executed"])
+                    trace = list(state["trace"])
+                    if state["parked"]:
+                        parked = {
+                            "mask": np.asarray(extra["parked_mask"]).astype(bool),
+                            "x": np.asarray(extra["parked_x"]),
+                            "f": np.asarray(extra["parked_f"]),
+                        }
+                    if len(row_src) != s:
+                        # the snapshot was compacted: rebuild the dispatch
+                        # arguments for the restored active set
+                        x_dev, mc_dev, xl_dev, xu_dev = self._place_rows(
+                            x, minimize_class, xl_ml, xu_ml, row_src
+                        )
+        menu = self._compaction_menu() if check else None
         while done < n_steps:
             length = min(chunk, n_steps - done)
+            if check:
+                # re-align on gate boundaries: a checkpoint cap below can
+                # shift ``done`` off the check multiples, and the gate must
+                # keep firing every ``check`` generations regardless
+                length = min(length, check - done % check)
             if cp is not None:
                 length = min(
                     length, self.checkpoint_every - done % self.checkpoint_every
@@ -504,6 +806,7 @@ class Moeva2:
                 params, x_dev, mc_dev, xl_dev, xu_dev, carry, length=length
             )
             done += length
+            gens_executed += length
 
             def flush_pending():
                 # fetch the in-flight chunk; with checkpointing it also
@@ -522,6 +825,71 @@ class Moeva2:
                 # fetching the *previous* chunk overlaps with its compute
                 flush_pending()
                 pending = gen_hist
+            if check and done % check == 0 and done < n_steps:
+                succ = np.asarray(jax.device_get(self._success_mask(carry)))
+                solved = row_live & succ
+                n_parked = int(solved.sum())
+                if n_parked:
+                    # park: freeze the solved rows' returned populations on
+                    # host — success observed now can no longer be lost,
+                    # archive or not
+                    idx = np.where(solved)[0]
+                    if parked is None:
+                        cols = self.pop_size + self.archive_size
+                        parked = {
+                            "mask": np.zeros(s, dtype=bool),
+                            "x": np.zeros(
+                                (s, cols, self.codec.gen_length),
+                                dtype=np.dtype(self.dtype),
+                            ),
+                            "f": np.zeros(
+                                (s, cols, 3), dtype=np.dtype(self.dtype)
+                            ),
+                        }
+                    px, pf = jax.device_get(self._final_columns(carry, idx))
+                    parked["mask"][row_src[idx]] = True
+                    parked["x"][row_src[idx]] = px
+                    parked["f"][row_src[idx]] = pf
+                    row_live = row_live & ~succ
+                n_active = int(row_live.sum())
+                if n_active == 0:
+                    # every state holds a success: skip the remaining budget
+                    trace.append(
+                        {"gen": done, "active": 0, "bucket": len(row_src)}
+                    )
+                    break
+                bucket = (
+                    menu.shrink_bucket(n_active, len(row_src)) if menu else None
+                )
+                if bucket is not None:
+                    # compact: repack the unsolved active set down the shared
+                    # bucket menu (pads duplicate the last live row; their
+                    # results are never read back)
+                    keep = np.where(row_live)[0]
+                    sel = np.concatenate(
+                        [keep, np.full(bucket - n_active, keep[-1], keep.dtype)]
+                    )
+                    carry = self._take_carry(carry, sel)
+                    row_src = row_src[sel]
+                    row_live = np.concatenate(
+                        [
+                            np.ones(n_active, dtype=bool),
+                            np.zeros(bucket - n_active, dtype=bool),
+                        ]
+                    )
+                    x_dev, mc_dev, xl_dev, xu_dev = self._place_rows(
+                        x, minimize_class, xl_ml, xu_ml, row_src
+                    )
+                    trace.append(
+                        {"gen": done, "active": n_active, "bucket": bucket}
+                    )
+                elif n_parked:
+                    # states parked without a repack (no smaller menu size):
+                    # record the gate anyway — the trace must account for
+                    # every convergence, not only bucket transitions
+                    trace.append(
+                        {"gen": done, "active": n_active, "bucket": len(row_src)}
+                    )
             if (
                 cp is not None
                 and done < n_steps
@@ -529,26 +897,67 @@ class Moeva2:
             ):
                 # a snapshot only counts history already durable on disk
                 flush_pending()
-                cp.save(carry, done, n_hist=len(hist_chunks))
-        if pending is not None:
-            hist_chunks.append(np.asarray(jax.device_get(pending)))
-        pop_x, pop_f, arch_x, arch_f, _, _ = carry
+                cp.save(
+                    carry,
+                    done,
+                    n_hist=len(hist_chunks),
+                    extra=self._early_stop_extra(
+                        s, row_src, row_live, parked, gens_executed, trace
+                    )
+                    if check
+                    else None,
+                )
+        return _InFlightRun(
+            x=x,
+            t0=t0,
+            carry=carry,
+            row_src=row_src,
+            row_live=row_live,
+            parked=parked,
+            check=check,
+            n_steps=n_steps,
+            gens_executed=gens_executed,
+            trace=trace,
+            init_hist=init_hist,
+            hist_chunks=hist_chunks,
+            pending=pending,
+            cp=cp,
+        )
+
+    def _finalize_one(self, run: _InFlightRun) -> MoevaResult:
+        if run.pending is not None:
+            run.hist_chunks.append(np.asarray(jax.device_get(run.pending)))
+            run.pending = None
+        pop_x, pop_f, arch_x, arch_f, _, _ = run.carry
         if self.archive_size:
             # archive members join the returned populations (extra columns)
             pop_x = jnp.concatenate([pop_x, arch_x], axis=1)
             pop_f = jnp.concatenate([pop_f, arch_f], axis=1)
         pop_x, pop_f = jax.device_get((pop_x, pop_f))
-        elapsed = time.time() - t0
-        if cp is not None:
-            cp.clear()  # run finished: recovery artifacts no longer needed
+        s = run.x.shape[0]
+        if run.parked is not None or len(run.row_src) != s:
+            # merge: parked rows keep their frozen populations; surviving
+            # rows land back at their original indices; pad rows drop
+            out_x = np.zeros((s,) + pop_x.shape[1:], pop_x.dtype)
+            out_f = np.zeros((s,) + pop_f.shape[1:], pop_f.dtype)
+            if run.parked is not None:
+                m = run.parked["mask"]
+                out_x[m] = run.parked["x"][m]
+                out_f[m] = run.parked["f"][m]
+            out_x[run.row_src[run.row_live]] = pop_x[run.row_live]
+            out_f[run.row_src[run.row_live]] = pop_f[run.row_live]
+            pop_x, pop_f = out_x, out_f
+        elapsed = time.time() - run.t0
+        if run.cp is not None:
+            run.cp.clear()  # run finished: recovery artifacts no longer needed
 
         history = None
         if self.save_history:
-            init_hist = np.asarray(jax.device_get(init_hist))
+            init_hist = np.asarray(jax.device_get(run.init_hist))
             # (n_gen-1, S, O, C) across chunks
             gen_hist = (
-                np.concatenate(hist_chunks, axis=0)
-                if hist_chunks
+                np.concatenate(run.hist_chunks, axis=0)
+                if run.hist_chunks
                 else np.zeros((0, *init_hist.shape))
             )
             history = [init_hist] + [gen_hist[i] for i in range(gen_hist.shape[0])]
@@ -566,17 +975,27 @@ class Moeva2:
                 codec_lib.genetic_to_ml(
                     self.codec,
                     jnp.asarray(pop_x),
-                    jnp.asarray(x, self.dtype)[:, None, :],
+                    jnp.asarray(run.x, self.dtype)[:, None, :],
                 )
             )
+        early_stop = None
+        if run.check:
+            early_stop = {
+                "check_every": run.check,
+                "gens_executed": run.gens_executed,
+                "budget_gens": run.n_steps,
+                "compaction": run.trace,
+            }
         return MoevaResult(
             x_gen=np.asarray(pop_x),
             f=np.asarray(pop_f),
             x_ml=x_ml,
-            x_initial=x,
+            x_initial=run.x,
             n_gen=self.n_gen,
             time=elapsed,
             history=history,
+            gens_executed=run.gens_executed,
+            early_stop=early_stop,
         )
 
     def _fingerprint(
@@ -605,6 +1024,10 @@ class Moeva2:
         knobs = [
             self.n_gen, self.pop_size, self.n_offsprings, self.seed,
             self.init, self.init_eps, self.init_ratio, self.archive_size,
+            # early-exit knobs change the dispatch schedule and (via
+            # compaction) the RNG stream, so they are attack identity
+            self.early_stop_check_every, self.early_stop_threshold,
+            self.early_stop_eps, tuple(self.compaction_buckets or ()),
             str(self.save_history), str(self.norm), self.crossover_prob,
             self.eta_mutation, str(np.dtype(self.dtype)),
             type(self.constraints).__name__,

@@ -268,6 +268,66 @@ def build_mesh(config: dict):
     return Mesh(np.array(devices), ("states",))
 
 
+#: The one batch-shape menu for every fixed-shape dispatch path: the serving
+#: microbatcher's bucket sizes AND the MoEvA early-exit compaction targets.
+#: Power-of-two keeps the compile surface logarithmic in the largest batch
+#: while padding waste stays < 2x; every production mesh size (1/2/4/8)
+#: divides every entry, so bucketed batches satisfy the states-axis
+#: divisibility contract (``attacks/sharding.py``) without re-padding.
+DEFAULT_BUCKET_SIZES = (8, 16, 32, 64, 128, 256)
+
+
+class RequestTooLarge(ValueError):
+    """A row count exceeds the largest bucket; it can never dispatch."""
+
+
+class BucketMenu:
+    """The fixed menu of allowed batch shapes.
+
+    Shared source of truth for every fixed-shape dispatch path (serving
+    batches, MoEvA active-set compaction): small and power-of-two so the
+    compile surface stays bounded (one program per size actually used)
+    while padding waste stays < 2x; every size must be a mesh-size multiple
+    so bucketed batches satisfy the states-axis divisibility contract
+    (``attacks/sharding.py``) without re-padding.
+    """
+
+    def __init__(self, sizes=DEFAULT_BUCKET_SIZES, mesh_size: int = 1):
+        sizes = sorted(int(s) for s in sizes)
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"bucket menu must be non-empty positive: {sizes}")
+        if len(set(sizes)) != len(sizes):
+            raise ValueError(f"bucket menu has duplicates: {sizes}")
+        bad = [s for s in sizes if s % mesh_size]
+        if bad:
+            raise ValueError(
+                f"bucket sizes {bad} are not multiples of the mesh size "
+                f"{mesh_size}; the states-axis sharding contract requires "
+                "mesh-aligned batch shapes"
+            )
+        self.sizes = tuple(sizes)
+        self.max_size = sizes[-1]
+
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest menu size that fits ``n_rows``."""
+        for s in self.sizes:
+            if n_rows <= s:
+                return s
+        raise RequestTooLarge(
+            f"{n_rows} rows exceed the largest bucket {self.max_size}"
+        )
+
+    def shrink_bucket(self, n_rows: int, current: int) -> int | None:
+        """Smallest menu size that fits ``n_rows`` and is strictly below the
+        ``current`` batch shape — the compaction question ("is repacking the
+        active set worth a smaller executable?"). None when no menu size
+        improves on ``current`` (including ``n_rows`` above the menu)."""
+        for s in self.sizes:
+            if n_rows <= s:
+                return s if s < current else None
+        return None
+
+
 def pad_states(
     x: np.ndarray, mesh, bucket: int | None = None
 ) -> tuple[np.ndarray, int]:

@@ -118,6 +118,23 @@ def run(config: dict, pipeline=None):
         # per-point run identity: host-side dispatch knobs on the cached engine
         moeva.n_gen = config["budget"]
         moeva.seed = config["seed"]
+        # success-gated early exit (0 = strict/parity mode): a host-side
+        # dispatch knob — compaction reuses the shared bucket-menu
+        # executables, so it is not engine-cache key material
+        moeva.early_stop_check_every = int(
+            config.get("early_stop_check_every", 0) or 0
+        )
+        moeva.early_stop_threshold = float(
+            config.get(
+                "early_stop_threshold",
+                config.get("misclassification_threshold", 0.5),
+            )
+        )
+        moeva.early_stop_eps = float(config.get("early_stop_eps", np.inf))
+        # reset like every other host-side knob: a serving layer sharing
+        # this cached engine may have pointed it at its own bucket menu
+        buckets = config.get("compaction_buckets")
+        moeva.compaction_buckets = tuple(buckets) if buckets else None
         # crash recovery: a rerun of this config hash resumes mid-attack
         # from the last ``checkpoint_every``-generation boundary instead of
         # generation 0 (config-hash skip only covers *completed* runs)
@@ -209,6 +226,11 @@ def run(config: dict, pipeline=None):
             "execution": {
                 "max_states_per_call": moeva.effective_states_chunk(),
                 "mesh": describe_mesh(moeva.mesh),
+                # early-exit mode of this number: the knob (0 = strict, the
+                # bit-identical default) and the generation steps actually
+                # executed vs the static budget (summed across state chunks)
+                "early_stop_check_every": moeva.early_stop_check_every,
+                "gens_executed": int(result.gens_executed),
             },
             "timings": timer.spans,
             "counters": timer.counters,

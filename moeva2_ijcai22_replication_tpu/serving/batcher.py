@@ -7,9 +7,10 @@ queue FIFO per batch key (everything that must be identical within one
 device dispatch: engine static config + runtime ε/ε-step/budget), a flusher
 coalesces each key's queue up to a deadline (``max_delay_s``) or capacity
 (a full largest bucket), pads the concatenated states axis to a small fixed
-menu of bucket sizes (:class:`BucketMenu` — power-of-two, mesh-size
-multiples, via ``experiments.common.pad_states``), dispatches ONE program
-per bucket, and scatters per-request row slices back.
+menu of bucket sizes (``experiments.common.BucketMenu`` — power-of-two,
+mesh-size multiples, shared with the MoEvA early-exit compaction path — via
+``experiments.common.pad_states``), dispatches ONE program per bucket, and
+scatters per-request row slices back.
 
 Semantics the service builds on:
 
@@ -42,7 +43,12 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ..experiments.common import pad_states
+from ..experiments.common import (  # noqa: F401 — BucketMenu/RequestTooLarge
+    BucketMenu,  # re-exported: the menu moved to experiments.common so the
+    DEFAULT_BUCKET_SIZES,  # batcher, pad_states, and the MoEvA early-exit
+    RequestTooLarge,  # compaction path all consume ONE size source of truth
+    pad_states,
+)
 
 
 class QueueFull(Exception):
@@ -51,10 +57,6 @@ class QueueFull(Exception):
     def __init__(self, msg: str, retry_after_s: float = 0.05):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
-
-
-class RequestTooLarge(ValueError):
-    """A single request exceeds the largest bucket; it can never dispatch."""
 
 
 class DeadlineExceeded(Exception):
@@ -68,42 +70,6 @@ class BatchExecutionError(Exception):
         super().__init__(f"batch for key {key!r} failed: {cause!r}")
         self.key = key
         self.cause = cause
-
-
-class BucketMenu:
-    """The fixed menu of allowed batch shapes.
-
-    Small and power-of-two so the compile surface stays bounded (one
-    program per size actually used) while padding waste stays < 2x; every
-    size must be a mesh-size multiple so bucketed batches satisfy the
-    states-axis divisibility contract (``attacks/sharding.py``) without
-    re-padding.
-    """
-
-    def __init__(self, sizes=(8, 16, 32, 64, 128, 256), mesh_size: int = 1):
-        sizes = sorted(int(s) for s in sizes)
-        if not sizes or sizes[0] < 1:
-            raise ValueError(f"bucket menu must be non-empty positive: {sizes}")
-        if len(set(sizes)) != len(sizes):
-            raise ValueError(f"bucket menu has duplicates: {sizes}")
-        bad = [s for s in sizes if s % mesh_size]
-        if bad:
-            raise ValueError(
-                f"bucket sizes {bad} are not multiples of the mesh size "
-                f"{mesh_size}; the states-axis sharding contract requires "
-                "mesh-aligned batch shapes"
-            )
-        self.sizes = tuple(sizes)
-        self.max_size = sizes[-1]
-
-    def bucket_for(self, n_rows: int) -> int:
-        """Smallest menu size that fits ``n_rows``."""
-        for s in self.sizes:
-            if n_rows <= s:
-                return s
-        raise RequestTooLarge(
-            f"{n_rows} rows exceed the largest bucket {self.max_size}"
-        )
 
 
 @dataclass

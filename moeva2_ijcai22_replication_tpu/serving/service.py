@@ -99,7 +99,7 @@ class AttackService:
         self,
         domains: dict[str, dict],
         *,
-        bucket_sizes=(8, 16, 32, 64, 128, 256),
+        bucket_sizes=common.DEFAULT_BUCKET_SIZES,
         max_delay_s: float = 0.010,
         max_queue_rows: int = 4096,
         seed: int = 42,
@@ -156,6 +156,12 @@ class AttackService:
             "init_ratio",
             "assoc_block",
             "max_states_per_call",
+            # MoEvA early exit: host-side dispatch knobs — they enter the
+            # batch key (a request opting in must not share a dispatch with
+            # strict-mode batch-mates) but not the engine-cache key
+            "early_stop_check_every",
+            "early_stop_threshold",
+            "early_stop_eps",
         ):
             if k in cfg:
                 pseudo[k] = cfg[k]
@@ -250,6 +256,13 @@ class AttackService:
             budget = int(req.budget)
             seed = self.seed
             bit_identical = False  # chunk/batch-shaped PRNG key folds
+            # per-request early-exit opt-in (via ``params``): easy rows stop
+            # paying for the full budget — lower p99 for solved-fast batches.
+            # Compaction repacks down the SAME bucket menu the batcher pads
+            # up to, so early-exit dispatches add no new executable shapes.
+            early_stop = int(pseudo.get("early_stop_check_every", 0) or 0)
+            es_threshold = float(pseudo.get("early_stop_threshold", 0.5))
+            es_eps = float(pseudo.get("early_stop_eps", np.inf))
 
             def dispatch(x_batch: np.ndarray) -> np.ndarray:
                 constraints.check_constraints_error(x_batch)
@@ -257,6 +270,10 @@ class AttackService:
                 # host-side dispatch knobs, per the engine-cache contract
                 engine.n_gen = budget
                 engine.seed = seed
+                engine.early_stop_check_every = early_stop
+                engine.early_stop_threshold = es_threshold
+                engine.early_stop_eps = es_eps
+                engine.compaction_buckets = self.menu.sizes
                 result = engine.generate(x_batch, 1)
                 self.metrics.count("compiles", engine.trace_count - traces0)
                 return np.asarray(result.x_ml)
@@ -268,23 +285,32 @@ class AttackService:
             # revalidate the menu against this domain's mesh: every bucket
             # must satisfy the states-axis divisibility contract
             BucketMenu(self.menu.sizes, mesh_size=mesh.size)
+        execution = {
+            "max_states_per_call": chunk,
+            "mesh": describe_mesh(mesh),
+            "bucket_menu": list(self.menu.sizes),
+        }
+        if req.attack == "moeva":
+            # the early-exit mode travels with every served number, like the
+            # metrics JSONs' execution block
+            execution["early_stop_check_every"] = early_stop
         res = _Resolved(
             key=key,
             dispatch=dispatch,
             mesh=mesh,
             bit_identical=bit_identical,
             n_features=n_features,
-            execution={
-                "max_states_per_call": chunk,
-                "mesh": describe_mesh(mesh),
-                "bucket_menu": list(self.menu.sizes),
-            },
+            execution=execution,
             meta={
                 "domain": req.domain,
                 "attack": req.attack,
                 "loss_evaluation": req.loss_evaluation,
-                "eps": eps,
-                "eps_step": eps_step,
+                # ε/ε-step are PGD coordinates; the MoEvA dispatch never
+                # reads them, and since they are not in the moeva resolve
+                # key the first resolver's values would otherwise leak into
+                # every later response's meta
+                "eps": eps if req.attack == "pgd" else None,
+                "eps_step": eps_step if req.attack == "pgd" else None,
                 "budget": int(req.budget),
             },
         )

@@ -1,0 +1,433 @@
+"""Success-gated early exit + active-set compaction (fixture-free, quick).
+
+The whole module runs on the code-derived synthetic LCLD schema
+(``synth_lcld_schema``) — no ``/root/reference`` tree required — and pins
+the early-exit contract:
+
+- **strict mode** (``early_stop_check_every=0``, the default) and a
+  segmented run whose gate never fires are bit-identical to the one-scan
+  program (this also pins carry donation across chained segments);
+- a compaction run with ``archive_size > 0`` reaches success rates >= the
+  fixed-budget run at the same generation budget (parking freezes observed
+  successes; the archive makes the criterion monotone);
+- the executable count of a shrinking run is bounded by the bucket-menu
+  length (compaction repacks down the shared serving menu, one program per
+  menu size actually visited);
+- the checkpoint sidecar stores the active-set mapping, so a compacted run
+  resumes bit-identically (slow tier, like every checkpoint test);
+- runner metrics and serving responses carry the early-exit execution mode.
+
+Engines own their compiled programs, so runs that several tests inspect are
+module-scoped fixtures — one compile per engine config for the module.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import (
+    synth_lcld,
+    synth_lcld_schema,
+)
+from moeva2_ijcai22_replication_tpu.experiments.common import (
+    DEFAULT_BUCKET_SIZES,
+    BucketMenu,
+)
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+
+
+@pytest.fixture(scope="module")
+def problem(tmp_path_factory):
+    import joblib
+    from sklearn.preprocessing import MinMaxScaler
+
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    tmp = tmp_path_factory.mktemp("early_stop")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(16, cons.schema, seed=3)
+    cons.check_constraints_error(x)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=7))
+    save_params(sur, str(tmp / "nn.msgpack"))
+    np.save(tmp / "x_candidates.npy", x)
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    joblib.dump(
+        MinMaxScaler().fit(np.vstack([x, xl, xu])), tmp / "scaler.joblib"
+    )
+    return {
+        "dir": tmp,
+        "paths": paths,
+        "constraints": cons,
+        "surrogate": sur,
+        "scaler": fit_minmax(x.min(0), x.max(0)),
+        "x": x,
+    }
+
+
+def _engine(problem, **kw):
+    kw.setdefault("n_gen", 21)
+    kw.setdefault("n_pop", 16)
+    kw.setdefault("n_offsprings", 8)
+    kw.setdefault("seed", 11)
+    kw.setdefault("archive_size", 4)
+    return Moeva2(
+        classifier=problem["surrogate"],
+        constraints=problem["constraints"],
+        ml_scaler=problem["scaler"],
+        norm=2,
+        dtype=jnp.float64,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def fixed_run(problem):
+    """The fixed-budget baseline: strict mode, full 20-generation scan."""
+    eng = _engine(problem)
+    return eng, eng.generate(problem["x"], 1)
+
+
+@pytest.fixture(scope="module")
+def early_run(problem):
+    """The compaction run every early-exit assertion inspects: same budget
+    and seed as ``fixed_run``, gate every 4 generations (dividing the 20
+    scan steps so all segments share one compiled length)."""
+    eng = _engine(
+        problem, early_stop_check_every=4, compaction_buckets=(2, 4, 8, 16)
+    )
+    return eng, eng.generate(problem["x"], 1)
+
+
+def _success(res, thr=0.5):
+    """Per-state engine-criterion success over the returned populations."""
+    f = res.f
+    return ((f[..., 0] < thr) & (f[..., 2] <= 0)).any(axis=1)
+
+
+class TestStrictMode:
+    def test_default_is_strict_and_reports_full_budget(self, fixed_run):
+        _, res = fixed_run
+        assert res.early_stop is None
+        assert res.gens_executed == 20  # n_gen - 1
+
+    def test_segmented_never_firing_gate_is_bit_identical(
+        self, problem, fixed_run
+    ):
+        """A gated run whose criterion never fires must equal the one-scan
+        strict program bit-for-bit: the check segmentation, the donated
+        carry chaining, and the mask fetches change no random draw."""
+        _, strict = fixed_run
+        gated = _engine(
+            problem, early_stop_check_every=4, early_stop_threshold=-1.0
+        ).generate(problem["x"], 1)
+        np.testing.assert_array_equal(strict.x_gen, gated.x_gen)
+        np.testing.assert_array_equal(strict.f, gated.f)
+        np.testing.assert_array_equal(strict.x_ml, gated.x_ml)
+        assert gated.gens_executed == 20
+        assert gated.early_stop["compaction"] == []
+
+    def test_history_and_early_stop_are_rejected(self, problem):
+        eng = _engine(problem, save_history="reduced", early_stop_check_every=2)
+        with pytest.raises(ValueError, match="save_history"):
+            eng.generate(problem["x"], 1)
+
+
+class TestCompaction:
+    def test_success_not_below_fixed_budget_with_archive(
+        self, fixed_run, early_run
+    ):
+        """Parking freezes every observed success and the archive makes the
+        criterion monotone, so an early-exit run can only match or beat the
+        fixed-budget run under its own criterion (at a budget where the
+        search saturates; mid-run RNG divergence is the documented caveat)."""
+        _, fixed = fixed_run
+        _, early = early_run
+        assert _success(early).sum() >= _success(fixed).sum()
+
+    def test_compaction_shrinks_and_merges_back_in_order(
+        self, problem, early_run
+    ):
+        _, res = early_run
+        # some state solved early enough to trigger at least one repack
+        assert len(res.early_stop["compaction"]) >= 1
+        for t in res.early_stop["compaction"]:
+            assert t["bucket"] <= 16 and t["gen"] % 4 == 0
+        # every state's rows decode against ITS OWN initial state: the
+        # immutable features pin the parked/active merge ordering
+        immutable = ~problem["constraints"].schema.mutable
+        np.testing.assert_allclose(
+            res.x_ml[:, :, immutable],
+            np.broadcast_to(
+                res.x_initial[:, None, immutable],
+                res.x_ml[:, :, immutable].shape,
+            ),
+        )
+        assert np.isfinite(res.f).all()
+        assert res.gens_executed <= res.early_stop["budget_gens"] == 20
+
+    def test_executable_count_bounded_by_menu_length(self, early_run):
+        """A shrinking run dispatches at most one segment program per menu
+        size: check_every divides n_gen-1, so every segment shares one
+        static length and shapes are the only retrace axis."""
+        eng, res = early_run
+        menu_len = len(eng._compaction_menu().sizes)
+        # trace_count counts init + every distinct segment executable
+        assert eng.trace_count - 1 <= menu_len
+        assert (
+            len({t["bucket"] for t in res.early_stop["compaction"]}) <= menu_len
+        )
+
+    def test_full_early_exit_skips_remaining_budget(self, problem):
+        """With a vacuous criterion every state succeeds at the first check
+        and the remaining budget is never dispatched."""
+        res = _engine(
+            problem, n_gen=41, early_stop_check_every=2,
+            early_stop_threshold=2.0,  # any candidate is 'misclassified'
+            early_stop_eps=np.inf,
+        ).generate(problem["x"], 1)
+        assert res.gens_executed == 2  # one check segment, then exit
+        assert res.early_stop["compaction"][-1]["active"] == 0
+        assert np.isfinite(res.f).all()
+
+    def test_mesh_sharded_compaction(self, problem):
+        """Compaction must keep the states axis mesh-aligned: buckets below
+        the mesh size are filtered from the menu, and repacked carries +
+        rebuilt dispatch args land back on the mesh. The candidate set is
+        built so the repack is deterministic: 10 states the surrogate
+        already misclassifies (their initial candidate satisfies the
+        criterion, so they park at the first gate) + 6 it does not — the
+        active set is <= 6 at generation 2, forcing the 16 -> 8 repack."""
+        import jax
+        from jax.sharding import Mesh
+
+        cons = problem["constraints"]
+        pool = synth_lcld(256, cons.schema, seed=9)
+        p1 = np.asarray(
+            problem["surrogate"].predict_proba(
+                problem["scaler"].transform(pool)
+            )
+        )[:, 1]
+        solved, unsolved = pool[p1 < 0.5], pool[p1 >= 0.5]
+        assert len(solved) >= 10 and len(unsolved) >= 6, "degenerate surrogate"
+        x = np.concatenate([solved[:10], unsolved[:6]])
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("states",))
+        eng = _engine(
+            problem,
+            n_gen=9,
+            early_stop_check_every=2,
+            compaction_buckets=(2, 4, 8, 16),
+            mesh=mesh,
+        )
+        assert eng._compaction_menu().sizes == (8, 16)  # mesh multiples only
+        res = eng.generate(x, 1)
+        trace = res.early_stop["compaction"]
+        assert trace and trace[0] == {"gen": 2, "active": trace[0]["active"], "bucket": 8}
+        assert trace[0]["active"] <= 6
+        assert np.isfinite(res.f).all()
+        # the 10 pre-solved states' frozen results hold the criterion
+        assert _success(res)[:10].all()
+        # the parked/active merge kept original row order
+        immutable = ~cons.schema.mutable
+        np.testing.assert_allclose(
+            res.x_ml[:, :, immutable],
+            np.broadcast_to(
+                res.x_initial[:, None, immutable],
+                res.x_ml[:, :, immutable].shape,
+            ),
+        )
+
+    def test_chunked_states_compose_with_early_exit(self, problem):
+        res = _engine(
+            problem,
+            early_stop_check_every=4,
+            max_states_per_call=8,
+            compaction_buckets=(2, 4, 8),
+        ).generate(problem["x"], 1)
+        assert res.x_gen.shape[0] == 16
+        assert res.early_stop["budget_gens"] == 40  # 2 chunks x 20 steps
+        assert 0 < res.gens_executed <= 40
+        for t in res.early_stop["compaction"]:
+            assert t["chunk"] in (0, 1)
+
+
+class TestCheckpointActiveSet:
+    def test_misaligned_checkpoint_keeps_gate_cadence(self, problem, tmp_path):
+        """checkpoint_every not dividing early_stop_check_every shifts
+        segment boundaries; the gate must re-align and still fire every
+        ``check`` generations (here: a vacuous criterion must exit at the
+        FIRST gate, generation 4, not at the first accidental multiple)."""
+        res = _engine(
+            problem,
+            n_gen=41,
+            early_stop_check_every=4,
+            early_stop_threshold=2.0,
+            checkpoint_every=3,
+            checkpoint_path=str(tmp_path / "cp_misaligned.npz"),
+        ).generate(problem["x"], 1)
+        assert res.gens_executed == 4
+        assert res.early_stop["compaction"][-1] == {
+            "gen": 4, "active": 0, "bucket": 16,
+        }
+
+    @pytest.mark.slow
+    def test_resume_restores_mapping_and_parked_results(self, problem, tmp_path):
+        """Kill a compacted run mid-attack; the resumed run must finish from
+        the snapshot — same parked results, same active-set mapping — and
+        match the uninterrupted run bit-for-bit (the PRNG key and the
+        compaction schedule are both checkpoint state)."""
+        kw = dict(
+            early_stop_check_every=2,
+            compaction_buckets=(2, 4, 8, 16),
+            checkpoint_every=4,
+        )
+        cp_path = str(tmp_path / "cp_early.npz")
+        reference = _engine(problem, **kw).generate(problem["x"], 1)
+
+        class Boom(RuntimeError):
+            pass
+
+        eng = _engine(problem, **kw, checkpoint_path=cp_path)
+        orig = Moeva2._success_mask
+        calls = {"n": 0}
+
+        def bomb(self, carry):
+            calls["n"] += 1
+            if calls["n"] == 5:  # past a checkpoint boundary and a repack
+                raise Boom()
+            return orig(self, carry)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(Moeva2, "_success_mask", bomb):
+            with pytest.raises(Boom):
+                eng.generate(problem["x"], 1)
+
+        resumed = _engine(problem, **kw, checkpoint_path=cp_path).generate(
+            problem["x"], 1
+        )
+        np.testing.assert_array_equal(resumed.x_gen, reference.x_gen)
+        np.testing.assert_array_equal(resumed.f, reference.f)
+        assert (
+            resumed.early_stop["compaction"]
+            == reference.early_stop["compaction"]
+        )
+
+
+class TestRunnerAndServingPlumbing:
+    def _base_config(self, problem, out_dir, **over):
+        tmp = problem["dir"]
+        cfg = {
+            "project_name": "lcld",
+            "attack_name": "moeva",
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": problem["paths"]["features"],
+                "constraints": problem["paths"]["constraints"],
+                "x_candidates": str(tmp / "x_candidates.npy"),
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "dirs": {"results": str(out_dir)},
+            "misclassification_threshold": 0.5,
+            "norm": 2,
+            "n_initial_state": -1,
+            "initial_state_offset": 0,
+            "system": {"n_jobs": 1, "verbose": 0},
+            "save_history": False,
+            "reconstruction": False,
+            "seed": 42,
+            "budget": 5,
+            "n_pop": 16,
+            "n_offsprings": 8,
+            "eps_list": [0.5],
+            "archive_size": 4,
+        }
+        cfg.update(over)
+        return cfg
+
+    def test_runner_metrics_carry_early_exit_execution(self, problem, tmp_path):
+        from moeva2_ijcai22_replication_tpu.experiments import moeva as moeva_runner
+
+        cfg = self._base_config(
+            problem, tmp_path / "out", early_stop_check_every=2
+        )
+        metrics = moeva_runner.run(cfg)
+        ex = metrics["execution"]
+        assert ex["early_stop_check_every"] == 2
+        assert 0 < ex["gens_executed"] <= 4
+        with open(
+            tmp_path / "out" / f"metrics_moeva_{metrics['config_hash']}.json"
+        ) as f:
+            on_disk = json.load(f)
+        assert on_disk["execution"] == ex
+
+    def test_serving_per_request_opt_in(self, problem):
+        from moeva2_ijcai22_replication_tpu.serving import (
+            AttackRequest,
+            AttackService,
+        )
+
+        tmp = problem["dir"]
+        domain = {
+            "project_name": "lcld",
+            "norm": 2,
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": problem["paths"]["features"],
+                "constraints": problem["paths"]["constraints"],
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "system": {"mesh_devices": 0},
+        }
+        svc = AttackService(
+            {"lcld": domain}, bucket_sizes=(8, 16), max_delay_s=0.01
+        )
+        try:
+            resp = svc.attack(
+                AttackRequest(
+                    domain="lcld",
+                    x=problem["x"][:3],
+                    attack="moeva",
+                    budget=5,
+                    params={
+                        "n_pop": 16,
+                        "n_offsprings": 8,
+                        "archive_size": 4,
+                        "early_stop_check_every": 2,
+                    },
+                ),
+                timeout=600.0,
+            )
+            assert resp.meta["execution"]["early_stop_check_every"] == 2
+            assert resp.x_adv.shape[0] == 3 and resp.x_adv.ndim == 3
+        finally:
+            svc.close()
+
+
+class TestMenuSingleSource:
+    def test_serving_menu_is_the_shared_menu(self):
+        from moeva2_ijcai22_replication_tpu.serving import batcher
+
+        assert batcher.BucketMenu is BucketMenu
+        assert batcher.DEFAULT_BUCKET_SIZES is DEFAULT_BUCKET_SIZES
+
+    def test_engine_compaction_consumes_shared_menu(self, problem):
+        eng = _engine(problem)
+        assert eng._compaction_menu().sizes == tuple(sorted(DEFAULT_BUCKET_SIZES))
+
+    def test_shrink_bucket_semantics(self):
+        menu = BucketMenu((8, 16, 32))
+        assert menu.shrink_bucket(5, 32) == 8
+        assert menu.shrink_bucket(9, 32) == 16
+        assert menu.shrink_bucket(9, 16) is None  # no smaller fit
+        assert menu.shrink_bucket(40, 32) is None  # above the menu

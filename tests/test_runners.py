@@ -215,9 +215,32 @@ class TestExecutionMetadata:
         with open(tmp_path / "out" / f"metrics_moeva_{h}.json") as f:
             on_disk = json.load(f)
         for m in (metrics, on_disk):
-            # no mesh -> the configured chunk is used as-is
-            assert m["execution"] == {"max_states_per_call": 6, "mesh": None}
+            # no mesh -> the configured chunk is used as-is; default strict
+            # mode -> every chunk runs its full budget (2 chunks x 2 steps)
+            assert m["execution"] == {
+                "max_states_per_call": 6,
+                "mesh": None,
+                "early_stop_check_every": 0,
+                "gens_executed": 4,
+            }
             assert m["includes_compile"] == ("attack_compile" in m["timings"])
+
+    def test_moeva_early_stop_knob_lands_in_execution(self, artifacts, tmp_path):
+        """An early-exit run's metrics carry the knob and the (possibly
+        reduced) generation count — the execution mode must travel with the
+        committed number exactly like chunk size and mesh shape."""
+        cfg = base_config(artifacts, tmp_path / "out", budget=5)
+        cfg["early_stop_check_every"] = 2
+        cfg["archive_size"] = 4
+        metrics = moeva_runner.run(cfg)
+        h = metrics["config_hash"]
+        with open(tmp_path / "out" / f"metrics_moeva_{h}.json") as f:
+            on_disk = json.load(f)
+        for m in (metrics, on_disk):
+            ex = m["execution"]
+            assert ex["early_stop_check_every"] == 2
+            assert 0 < ex["gens_executed"] <= 4
+        assert on_disk["execution"] == metrics["execution"]
 
 
 class TestGridRunner:

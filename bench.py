@@ -316,11 +316,19 @@ def run_early_exit_bench() -> dict | None:
         p1 = np.asarray(sur.predict_proba(scaler.transform(pool)))[:, 1]
         x = pool[np.argsort(np.abs(p1 - threshold))[:s]]
 
+        from moeva2_ijcai22_replication_tpu.observability import (
+            Trace, TraceRecorder, telemetry_block, validate_record,
+        )
+
         moeva = Moeva2(
             classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
             n_gen=n_gen, n_pop=n_pop, n_offsprings=n_off, seed=42,
             archive_size=8, early_stop_threshold=threshold,
         )
+        # gate progress events (gen index, success fraction, active set,
+        # HBM) land in the record's telemetry block
+        recorder = TraceRecorder(spans_enabled=True)
+        moeva.trace = Trace(recorder, trace_id="bench-early-exit")
 
         def timed(check_every):
             moeva.early_stop_check_every = check_every
@@ -364,7 +372,19 @@ def run_early_exit_bench() -> dict | None:
             "bucket_menu_len": menu_len,
             "success_fixed": round(success(fixed), 4),
             "success_early": round(success(early), 4),
+            # shared record schema (observability.records): execution mode
+            # + telemetry travel with every committed number
+            "execution": {
+                "max_states_per_call": moeva.effective_states_chunk(),
+                "mesh": None,
+                "early_stop_check_every": check,
+                "gens_executed": int(early.gens_executed),
+            },
+            "telemetry": telemetry_block(
+                recorder=recorder, trace=moeva.trace
+            ),
         }
+        validate_record(record, "early_exit")
         log(
             f"[bench] early_exit: fixed {fixed_s:.2f}s vs early {early_s:.2f}s "
             f"({record['speedup']}x), gens {early.gens_executed}/{n_gen - 1}, "
@@ -509,18 +529,27 @@ def run_serving_bench() -> dict | None:
 
 
 def main():
+    def _wrap(metric: str, key: str, rec: dict | None) -> dict:
+        # the printed record mirrors the sub-record's shared schema keys
+        # (execution + telemetry) so every bench JSON line carries them
+        out = {"metric": metric, key: rec}
+        if rec:
+            out["execution"] = rec.get("execution")
+            out["telemetry"] = rec.get("telemetry")
+        return out
+
     # --serving: ONLY the request-path sweep — no grid subprocesses, no
     # network, one process; the CI-reproducible serving record.
     if "--serving" in sys.argv:
         rec = run_serving_bench()
-        print(json.dumps({"metric": "serving_offered_load_sweep", "serving": rec}))
+        print(json.dumps(_wrap("serving_offered_load_sweep", "serving", rec)))
         return
 
     # --early-exit: ONLY the success-gated early-exit A/B — synthetic
     # schema, one process, CPU-able; the CI-reproducible early_exit record.
     if "--early-exit" in sys.argv:
         rec = run_early_exit_bench()
-        print(json.dumps({"metric": "moeva_early_exit_ab", "early_exit": rec}))
+        print(json.dumps(_wrap("moeva_early_exit_ab", "early_exit", rec)))
         return
 
     # Whole-grid wallclock FIRST: its subprocesses need the (exclusive) TPU,
@@ -564,6 +593,16 @@ def main():
         classifier=sur, constraints=cons, ml_scaler=scaler,
         norm=2, n_gen=N_GEN, n_pop=N_POP, n_offsprings=N_OFF, seed=42,
     )
+    # unified tracing: engine progress events + HBM watermarks for the
+    # record's telemetry block (host-side emission only — the measured
+    # device programs are identical with or without it)
+    from moeva2_ijcai22_replication_tpu.attacks.sharding import describe_mesh
+    from moeva2_ijcai22_replication_tpu.observability import (
+        Trace, TraceRecorder, telemetry_block, validate_record,
+    )
+
+    bench_recorder = TraceRecorder(spans_enabled=True)
+    moeva.trace = Trace(bench_recorder, trace_id="bench-headline")
 
     t0 = time.time()
     res = moeva.generate(x, minimize_class=1)
@@ -659,7 +698,18 @@ def main():
         "steady_s": round(ours_s, 2),
         "cold_s": round(cold_s, 2),
         "speedup_cold": round(ref_s / cold_s, 2),
+        # shared record schema (observability.records)
+        "execution": {
+            "max_states_per_call": moeva.effective_states_chunk(),
+            "mesh": describe_mesh(moeva.mesh),
+            "n_states": N_STATES,
+            "n_gen": N_GEN,
+        },
+        "telemetry": telemetry_block(
+            recorder=bench_recorder, trace=moeva.trace
+        ),
     }
+    validate_record(record, "bench")
     if real_botnet:
         record["real_botnet"] = real_botnet
     if serving:

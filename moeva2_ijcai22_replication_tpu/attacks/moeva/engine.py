@@ -32,6 +32,7 @@ from ...core.constraints import ConstraintSet
 from ...core.norms import is_l2, lp_distance, validate_norm
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
+from ...observability import device_memory_stats
 from .initialisation import lp_ratio_init, tile_init
 from .operators import OperatorTables, make_operator_tables, make_offspring
 from .refdirs import energy_ref_dirs, rnsga3_geometry
@@ -197,6 +198,15 @@ class Moeva2:
     #: (``experiments.common.DEFAULT_BUCKET_SIZES``). Sizes not divisible by
     #: the mesh size are skipped (states-axis sharding contract).
     compaction_buckets: tuple | None = None
+    #: observability handle (``observability.Trace`` or None): a host-side
+    #: dispatch knob like ``seed`` — NOT engine-cache key material, reset
+    #: per grid point / serving batch by the callers. When set (and its
+    #: recorder has spans enabled) the engine emits per-gate progress
+    #: events — generation index, success fraction, active-set size, bucket
+    #: transitions — and per-phase device-memory watermarks into the
+    #: unified event stream. Pure host-side emission between dispatches:
+    #: device programs and RNG streams are untouched.
+    trace: Any = None
     dtype: Any = jnp.float32
     mesh: jax.sharding.Mesh | None = None
     states_axis: str = "states"
@@ -559,6 +569,15 @@ class Moeva2:
             self._launch_one(x, minimize_class, key, checkpoint_path)
         )
 
+    def _trace_event(self, name: str, **attrs) -> None:
+        """Emit a progress event (+ HBM watermark) into the attached trace;
+        no-op without one — the overhead contract of the tracing layer."""
+        tr = self.trace
+        if tr is None or not getattr(tr, "enabled", False):
+            return
+        dev = self.mesh.devices.flat[0] if self.mesh is not None else None
+        tr.event(name, hbm=device_memory_stats(dev), **attrs)
+
     # -- early-exit machinery ------------------------------------------------
     def _compaction_menu(self):
         """The shared fixed-shape dispatch menu, filtered to mesh-aligned
@@ -742,6 +761,7 @@ class Moeva2:
 
         t0 = time.time()
         carry, init_hist = self._jit_init(*args)
+        self._trace_event("moeva.init", states=int(s), n_gen=int(self.n_gen))
         n_steps = self.n_gen - 1
         # Without history or early exit a single segment reproduces the
         # one-scan program; with history, fixed-size segments bound HBM
@@ -857,6 +877,15 @@ class Moeva2:
                     trace.append(
                         {"gen": done, "active": 0, "bucket": len(row_src)}
                     )
+                    self._trace_event(
+                        "moeva.gate",
+                        gen=int(done),
+                        active=0,
+                        parked=int(n_parked),
+                        success_frac=1.0,
+                        bucket=int(len(row_src)),
+                        early_exit=True,
+                    )
                     break
                 bucket = (
                     menu.shrink_bucket(n_active, len(row_src)) if menu else None
@@ -890,6 +919,18 @@ class Moeva2:
                     trace.append(
                         {"gen": done, "active": n_active, "bucket": len(row_src)}
                     )
+                # per-gate progress event: generation index, cumulative
+                # success fraction, active set, and the (possibly just
+                # shrunk) dispatch bucket — the between-gates visibility
+                # the early-exit scan lacked
+                self._trace_event(
+                    "moeva.gate",
+                    gen=int(done),
+                    active=n_active,
+                    parked=int(n_parked),
+                    success_frac=round(1.0 - n_active / s, 4),
+                    bucket=int(len(row_src)),
+                )
             if (
                 cp is not None
                 and done < n_steps
@@ -986,6 +1027,13 @@ class Moeva2:
                 "budget_gens": run.n_steps,
                 "compaction": run.trace,
             }
+        self._trace_event(
+            "moeva.done",
+            states=int(s),
+            gens_executed=int(run.gens_executed),
+            budget_gens=int(run.n_steps),
+            time_s=round(elapsed, 4),
+        )
         return MoevaResult(
             x_gen=np.asarray(pop_x),
             f=np.asarray(pop_f),

@@ -30,6 +30,7 @@ from ..attacks.moeva import Moeva2
 from ..attacks.objective import ObjectiveCalculator
 from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
+from ..observability import Trace, recorder_for, telemetry_block
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file, save_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
@@ -102,7 +103,16 @@ def run(config: dict, pipeline=None):
 
     os.makedirs(out_dir, exist_ok=True)
     print(config)
-    timer = PhaseTimer()
+    # run-scoped trace: spans on when the config sets ``system.trace_log``
+    # (JSONL sink shared by every run in the process); otherwise the trace
+    # is None and the timers/engine emit nothing beyond cheap counters
+    recorder = recorder_for(config)
+    trace = (
+        Trace(recorder, trace_id=f"run-{config_hash[:12]}", name=mid_fix)
+        if recorder.spans_enabled
+        else None
+    )
+    timer = PhaseTimer(trace=trace)
 
     # ----- Load and create necessary objects (04_moeva.py:41-60)
     with timer.phase("setup"):
@@ -135,6 +145,9 @@ def run(config: dict, pipeline=None):
         # this cached engine may have pointed it at its own bucket menu
         buckets = config.get("compaction_buckets")
         moeva.compaction_buckets = tuple(buckets) if buckets else None
+        # per-point observability handle (reset like seed/n_gen: a cached
+        # engine may carry the previous point's — or a serving batch's — trace)
+        moeva.trace = trace
         # crash recovery: a rerun of this config hash resumes mid-attack
         # from the last ``checkpoint_every``-generation boundary instead of
         # generation 0 (config-hash skip only covers *completed* runs)
@@ -234,6 +247,15 @@ def run(config: dict, pipeline=None):
             },
             "timings": timer.spans,
             "counters": timer.counters,
+            # shared record schema: span totals, engine progress events,
+            # and the device-memory watermark travel with the number
+            "telemetry": telemetry_block(
+                timer=timer,
+                trace=trace,
+                device=moeva.mesh.devices.flat[0]
+                if moeva.mesh is not None
+                else None,
+            ),
             "config": config,
             "config_hash": config_hash,
         }

@@ -29,6 +29,7 @@ from ..attacks.pgd import AutoPGD, ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sat import SatAttack
 from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
+from ..observability import Trace, recorder_for, telemetry_block
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
@@ -99,7 +100,14 @@ def run(config: dict, pipeline=None):
 
     os.makedirs(out_dir, exist_ok=True)
     print(config)
-    timer = PhaseTimer()
+    # run-scoped trace (spans on under ``system.trace_log``, see moeva.py)
+    recorder = recorder_for(config)
+    trace = (
+        Trace(recorder, trace_id=f"run-{config_hash[:12]}", name=mid_fix)
+        if recorder.spans_enabled
+        else None
+    )
+    timer = PhaseTimer(trace=trace)
     apply_sat = "sat" in config["loss_evaluation"]
 
     with timer.phase("setup"):
@@ -238,6 +246,14 @@ def run(config: dict, pipeline=None):
             },
             "timings": timer.spans,
             "counters": timer.counters,
+            # shared record schema (observability.records)
+            "telemetry": telemetry_block(
+                timer=timer,
+                trace=trace,
+                device=attack.mesh.devices.flat[0]
+                if attack.mesh is not None
+                else None,
+            ),
             "config": config,
             "config_hash": config_hash,
         }

@@ -38,6 +38,7 @@ import queue
 import threading
 import time
 
+from ..observability import TraceRecorder, telemetry_block, validate_record
 from ..utils.config import get_dict_hash
 from . import common
 
@@ -47,7 +48,7 @@ logger = logging.getLogger(__name__)
 class GridPipeline:
     """Shared execution context for one in-process grid sweep."""
 
-    def __init__(self):
+    def __init__(self, recorder=None):
         self._queue: queue.Queue = queue.Queue()
         self._pending: set[str] = set()
         self._lock = threading.Lock()
@@ -55,7 +56,15 @@ class GridPipeline:
         self._submitted = 0
         self.points: list[dict] = []
         self.write_failures: list[dict] = []
-        self._t0 = time.time()
+        # unified tracing recorder: the writer-queue depth gauge and grid
+        # counters are always-on cheap instruments; with spans enabled
+        # (``system.trace_log``) they also land in the event stream. The
+        # default is a counters-only recorder OWNED by this grid, so the
+        # report's telemetry reflects this sweep, not the whole process
+        self.recorder = (
+            recorder if recorder is not None else TraceRecorder(spans_enabled=False)
+        )
+        self._t0 = time.perf_counter()  # monotonic: NTP-step-proof wallclock
         self._artifacts0 = common.ARTIFACTS.stats()
         self._engines0 = common.ENGINES.stats()
 
@@ -69,12 +78,17 @@ class GridPipeline:
                 label, metrics_path, finalize = item
                 try:
                     finalize()
+                    self.recorder.count("grid_points_finalized")
                 except Exception as e:
                     logger.exception("grid point finalize failed: %s", label)
                     self.write_failures.append({"point": label, "error": repr(e)})
+                    self.recorder.count("grid_point_write_failures")
                 finally:
                     with self._lock:
                         self._pending.discard(metrics_path)
+                    self.recorder.gauge(
+                        "grid_writer_queue_depth", self._queue.qsize()
+                    )
             finally:
                 self._queue.task_done()
 
@@ -89,6 +103,7 @@ class GridPipeline:
                 self._thread.start()
         self._submitted += 1
         self._queue.put((label, metrics_path, finalize))
+        self.recorder.gauge("grid_writer_queue_depth", self._queue.qsize())
 
     def is_pending(self, metrics_path: str) -> bool:
         with self._lock:
@@ -118,6 +133,7 @@ class GridPipeline:
                 "_timer": timer,
             }
         )
+        self.recorder.count("grid_points_skipped" if skipped else "grid_points")
 
     @staticmethod
     def _delta(now: dict, before: dict) -> dict:
@@ -142,7 +158,7 @@ class GridPipeline:
         launched = [p for p in points if not p["skipped"]]
         report = {
             "grid_config_hash": get_dict_hash(grid_config),
-            "grid_wallclock_s": round(time.time() - self._t0, 3),
+            "grid_wallclock_s": round(time.perf_counter() - self._t0, 3),
             "points_total": len(points),
             "points_launched": len(launched),
             "points_skipped": len(points) - len(launched),
@@ -162,8 +178,19 @@ class GridPipeline:
                 "submitted": self._submitted,
                 "failures": self.write_failures,
             },
+            # the shared record schema (observability.records): execution
+            # mode + telemetry travel with every bench/grid/serving record
+            "execution": {
+                "pipeline": True,
+                "mesh_devices": int(
+                    (grid_config.get("system") or {}).get("mesh_devices", 0)
+                    or 0
+                ),
+            },
+            "telemetry": telemetry_block(recorder=self.recorder),
             "points": points,
         }
+        validate_record(report, "grid")
         for out_dir in out_dirs:
             try:
                 os.makedirs(out_dir, exist_ok=True)

@@ -57,9 +57,17 @@ class GridRunner:
         self.launch_counter = 0
         self.pipeline = None
         if not use_subprocess and config.get("pipeline", True):
+            from ..observability import recorder_for
             from .pipeline import GridPipeline
 
-            self.pipeline = GridPipeline()
+            # with ``system.trace_log`` the grid shares the sink-backed
+            # recorder (points + runs in one event stream); otherwise the
+            # pipeline owns a counters-only recorder so the grid report's
+            # telemetry reflects this sweep alone
+            rec = recorder_for(config)
+            self.pipeline = GridPipeline(
+                recorder=rec if rec.spans_enabled else None
+            )
         self._out_dirs: list[str] = []
         self.report: dict | None = None
 

@@ -1,0 +1,52 @@
+"""Unified tracing & telemetry subsystem.
+
+One id-correlated event stream for all three execution paths — the serving
+request lifecycle, the grid pipeline, and the MoEvA engine's early-exit
+gates — recorded into a bounded ring plus an optional append-only JSONL
+sink (config ``system.trace_log``), with exporters to Chrome/Perfetto
+trace-event JSON (``observability.export`` / ``tools/trace_export.py``)
+and Prometheus text exposition (``observability.prom`` behind
+``/metrics?format=prom``).
+
+Contract: cheap counters/gauges are always on; spans/events are opt-in
+(``TraceRecorder.spans_enabled``), and with them off every instrumented
+path is a no-op — zero extra device dispatches, zero extra compiles
+(pinned by the tier-1 overhead smoke). ``PhaseTimer`` and
+``ServiceMetrics`` (``utils/observability.py``) are thin facades over this
+recorder, so grid reports, bench records, and serving metadata share one
+event stream; ``records.telemetry_block`` / ``records.validate_record``
+keep every committed record carrying the shared ``execution`` +
+``telemetry`` schema.
+"""
+
+from .records import (
+    REQUIRED_RECORD_KEYS,
+    build_identity,
+    telemetry_block,
+    validate_record,
+)
+from .trace import (
+    Trace,
+    TraceRecorder,
+    current_trace,
+    default_recorder,
+    device_memory_stats,
+    maybe_span,
+    recorder_for,
+    use_trace,
+)
+
+__all__ = [
+    "REQUIRED_RECORD_KEYS",
+    "Trace",
+    "TraceRecorder",
+    "build_identity",
+    "current_trace",
+    "default_recorder",
+    "device_memory_stats",
+    "maybe_span",
+    "recorder_for",
+    "telemetry_block",
+    "use_trace",
+    "validate_record",
+]

@@ -1,0 +1,99 @@
+"""Trace events -> Chrome/Perfetto trace-event JSON.
+
+The recorder's event schema (``observability.trace``) is one JSON object
+per event; the Chrome trace-event format (consumed by ``chrome://tracing``
+and https://ui.perfetto.dev) wants microsecond ``ts``/``dur`` "X" complete
+events grouped by pid/tid. The mapping here assigns one pid per trace id
+(so every request/run renders as its own process track, with the trace id
+as the track name), "X" events for spans, "i" instants for point events,
+and "C" counter tracks for gauges. ``tools/trace_export.py`` is the CLI
+wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Load a ``system.trace_log`` JSONL sink (blank lines skipped)."""
+    events = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def to_chrome_trace(events: Iterable[dict]) -> dict:
+    """Render recorder events to a Chrome/Perfetto trace-event document."""
+    trace_events: list[dict] = []
+    pids: dict[str, int] = {}
+
+    def pid_for(trace_id) -> int:
+        tid = str(trace_id)
+        pid = pids.get(tid)
+        if pid is None:
+            pid = pids[tid] = len(pids) + 1
+            trace_events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": tid},
+                }
+            )
+        return pid
+
+    t0_wall = None
+    for ev in events:
+        kind = ev.get("kind")
+        ts_us = round(float(ev.get("ts", 0.0)) * 1e6, 1)
+        if kind == "meta":
+            t0_wall = ev.get("t0_wall", t0_wall)
+        elif kind == "span":
+            args = dict(ev.get("attrs") or {})
+            args["span"] = ev.get("span")
+            if ev.get("parent") is not None:
+                args["parent"] = ev["parent"]
+            trace_events.append(
+                {
+                    "name": ev.get("name", "?"),
+                    "ph": "X",
+                    "pid": pid_for(ev.get("trace", "?")),
+                    "tid": 0,
+                    "ts": ts_us,
+                    "dur": round(float(ev.get("dur", 0.0)) * 1e6, 1),
+                    "args": args,
+                }
+            )
+        elif kind == "event":
+            trace_events.append(
+                {
+                    "name": ev.get("name", "?"),
+                    "ph": "i",
+                    "s": "t",  # thread-scoped instant
+                    "pid": pid_for(ev.get("trace", "?")),
+                    "tid": 0,
+                    "ts": ts_us,
+                    "args": dict(ev.get("attrs") or {}),
+                }
+            )
+        elif kind == "gauge":
+            trace_events.append(
+                {
+                    "name": ev.get("name", "?"),
+                    "ph": "C",
+                    "pid": pid_for(ev.get("trace", "gauges")),
+                    "tid": 0,
+                    "ts": ts_us,
+                    "args": {"value": ev.get("value", 0.0)},
+                }
+            )
+    doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    if t0_wall is not None:
+        doc["otherData"] = {"t0_wall": t0_wall}
+    return doc

@@ -1,0 +1,80 @@
+"""Prometheus text exposition (format 0.0.4) for the serving metrics.
+
+Renders a :meth:`~..utils.observability.ServiceMetrics.snapshot` (plus the
+cache stats ``AttackService.metrics_snapshot`` appends) to the text format
+Prometheus scrapes: counters as ``<prefix>_<name>_total``, gauges as
+gauges, bounded sample streams as summaries (windowed p50/p99 quantiles +
+full-history ``_count``/``_sum``). ``/metrics?format=prom`` serves this
+next to the existing JSON snapshot — same numbers, one recorder, two
+wire formats.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name(prefix: str, name: str, suffix: str = "") -> str:
+    return f"{prefix}_{_NAME_RE.sub('_', str(name))}{suffix}"
+
+
+def _fmt(value) -> str:
+    v = float(value)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
+    """ServiceMetrics snapshot dict -> Prometheus exposition text."""
+    lines: list[str] = []
+
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        n = _name(prefix, name, "_total")
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(v)}")
+
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        n = _name(prefix, name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(v)}")
+
+    for name, s in sorted(snapshot.get("streams", {}).items()):
+        n = _name(prefix, name)
+        lines.append(f"# TYPE {n} summary")
+        for q, key in (("0.5", "p50"), ("0.99", "p99")):
+            v = s.get(key)
+            if v is not None and not (isinstance(v, float) and math.isnan(v)):
+                lines.append(f'{n}{{quantile="{q}"}} {_fmt(v)}')
+        count = int(s.get("count") or 0)
+        mean = s.get("mean")
+        lines.append(f"{n}_count {count}")
+        lines.append(
+            f"{n}_sum {_fmt((mean or 0.0) * count if mean is not None else 0.0)}"
+        )
+
+    # flat extras the service appends to its snapshot: scalar numbers become
+    # gauges, one-level dicts of numbers (cache stats) become one gauge per
+    # sub-key — so engine/artifact cache health is scrapeable too
+    for key, v in sorted(snapshot.items()):
+        if key in ("counters", "gauges", "streams"):
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            n = _name(prefix, key)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_fmt(v)}")
+        elif isinstance(v, dict):
+            for sub, sv in sorted(v.items()):
+                if isinstance(sv, (int, float)) and not isinstance(sv, bool):
+                    n = _name(prefix, f"{key}_{sub}")
+                    lines.append(f"# TYPE {n} gauge")
+                    lines.append(f"{n} {_fmt(sv)}")
+
+    return "\n".join(lines) + "\n"

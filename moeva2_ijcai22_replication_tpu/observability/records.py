@@ -1,0 +1,86 @@
+"""Shared record schema: every bench/grid/serving record carries the same
+``execution`` + ``telemetry`` keys.
+
+PR 2/3 grew per-record ``execution`` blocks (chunk size, mesh shape,
+early-exit mode) so committed numbers stay attributable to their execution
+mode; this module adds the matching ``telemetry`` block (span totals, HBM
+watermark, events emitted) and a validator the record producers call at
+assembly time — so a future refactor cannot silently drop either block
+from a record (``tests/test_tracing.py::TestRecordSchema`` additionally
+asserts the producers keep calling it).
+"""
+
+from __future__ import annotations
+
+from .trace import Trace, TraceRecorder, device_memory_stats
+
+#: the keys every bench / grid-report / serving-sweep record must carry.
+REQUIRED_RECORD_KEYS = ("execution", "telemetry")
+
+
+def telemetry_block(
+    *,
+    recorder: TraceRecorder | None = None,
+    timer=None,
+    trace: Trace | None = None,
+    device=None,
+) -> dict:
+    """JSON-ready telemetry summary for a record: span totals (from a
+    PhaseTimer), trace id + event count (from a Trace), recorder counters,
+    and the device-memory watermark at assembly time."""
+    block: dict = {"hbm": device_memory_stats(device)}
+    if timer is not None:
+        block["spans_s"] = {k: round(v, 4) for k, v in timer.spans.items()}
+        block["span_total_s"] = round(sum(timer.spans.values()), 4)
+    if trace is not None:
+        block["trace_id"] = trace.id
+        block["events"] = len(trace.events)
+    if recorder is not None:
+        block["events_emitted"] = recorder.events_emitted
+        block["counters"] = {k: int(v) for k, v in recorder.counters.items()}
+    return block
+
+
+def validate_record(record: dict, kind: str = "record") -> dict:
+    """Assert ``record`` carries the shared schema keys; returns it."""
+    missing = [k for k in REQUIRED_RECORD_KEYS if k not in record]
+    if missing:
+        raise ValueError(
+            f"{kind} record is missing schema keys {missing}: every "
+            f"bench/grid/serving record must carry {list(REQUIRED_RECORD_KEYS)}"
+        )
+    return record
+
+
+def git_describe() -> str | None:
+    """Best-effort build identity (``git describe``) of this checkout;
+    None outside a git work tree or without git on PATH."""
+    import os
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--tags"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def build_identity(config: dict) -> dict:
+    """Build/config identity for health endpoints: git describe
+    (best-effort), the md5 config hash, and the package version — what a
+    load balancer needs to detect a mis-deployed or mis-configured
+    replica."""
+    from .. import __version__
+    from ..utils.config import get_dict_hash
+
+    return {
+        "git": git_describe(),
+        "version": __version__,
+        "config_hash": get_dict_hash(config),
+    }

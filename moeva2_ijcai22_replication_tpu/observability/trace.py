@@ -1,0 +1,376 @@
+"""Structured tracing core: one id-correlated event stream for all paths.
+
+The package grew three disconnected instruments — ``PhaseTimer`` (grid
+points), ``ServiceMetrics`` (serving quantiles), and the raw
+``jax.profiler`` toggle — with no way to follow one attack request or one
+grid point end to end. This module is the shared substrate they all emit
+into:
+
+- :class:`TraceRecorder` — a process-scoped event store: a bounded
+  in-memory ring (``capacity`` most recent events) plus an optional
+  append-only JSONL sink (config ``system.trace_log``). **Cheap counters
+  and gauges are always on** (two dict writes under a lock); **span/event
+  recording is opt-in** (``spans_enabled``) so the hot paths pay nothing
+  when tracing is off — the overhead contract
+  ``tests/test_tracing.py::TestTracingOverhead`` pins (zero extra
+  dispatches, zero extra compiles on the serving smoke).
+- :class:`Trace` — a run/request-scoped context carrying an id, nested
+  ``span()``s (parentage tracked per thread; explicit-duration
+  ``record_span`` for clocks owned elsewhere, e.g. the microbatcher's
+  injectable clock) and point ``event()``s. ``tree()`` renders the nested
+  span tree JSON-ready (the ``/attack`` response payload); ``adopt()``
+  re-stamps another trace's events under this id (how per-batch device
+  spans land in every participating request's trace).
+
+Timestamps: ``ts`` is seconds since the recorder's epoch measured with
+``time.perf_counter()`` (monotonic — NTP steps cannot corrupt spans);
+``t0_wall`` in the sink's meta line anchors the epoch to wall time.
+Exporters: ``observability.export`` renders the JSONL/ring to
+Chrome/Perfetto trace-event JSON, ``observability.prom`` to Prometheus
+text exposition.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+
+#: process-global span-id source — ids stay unique across traces, so
+#: ``Trace.adopt`` can copy events between traces without remapping.
+_span_ids = itertools.count(1)
+
+#: ambient trace for code that cannot be handed one explicitly (the
+#: service's dispatch closures run under the batcher's per-batch trace).
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "moeva2_current_trace", default=None
+)
+
+
+class TraceRecorder:
+    """Bounded ring + optional JSONL sink + always-on counters/gauges."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        sink_path: str | None = None,
+        spans_enabled: bool | None = None,
+        clock=time.perf_counter,
+    ):
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        #: total events ever emitted (the ring keeps only the last
+        #: ``capacity``; this count never loses history)
+        self.events_emitted = 0
+        self._clock = clock
+        self._t0 = clock()
+        self.t0_wall = time.time()
+        self.sink_path = sink_path
+        self._sink = None
+        if sink_path:
+            os.makedirs(os.path.dirname(sink_path) or ".", exist_ok=True)
+            self._sink = open(sink_path, "a", buffering=1)
+        # a sink implies the caller wants spans; counters-only otherwise
+        self.spans_enabled = (
+            bool(sink_path) if spans_enabled is None else bool(spans_enabled)
+        )
+        if self._sink is not None:
+            # epoch anchor: exporters map monotonic ts back to wall time
+            self.emit(
+                {
+                    "kind": "meta",
+                    "t0_wall": round(self.t0_wall, 6),
+                    "pid": os.getpid(),
+                }
+            )
+
+    def now(self) -> float:
+        """Seconds since the recorder epoch (monotonic)."""
+        return self._clock() - self._t0
+
+    def emit(self, ev: dict) -> None:
+        with self._lock:
+            self._ring.append(ev)
+            self.events_emitted += 1
+            if self._sink is not None:
+                self._sink.write(json.dumps(ev, default=str) + "\n")
+
+    # -- always-on cheap instruments -----------------------------------------
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+        if self.spans_enabled:
+            self.emit(
+                {
+                    "kind": "gauge",
+                    "name": name,
+                    "value": float(value),
+                    "ts": round(self.now(), 6),
+                }
+            )
+
+    # -- introspection -------------------------------------------------------
+    def events(self) -> list[dict]:
+        """Snapshot of the ring (most recent ``capacity`` events)."""
+        with self._lock:
+            return list(self._ring)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "events_emitted": self.events_emitted,
+                "ring_size": len(self._ring),
+                "spans_enabled": self.spans_enabled,
+                "sink_path": self.sink_path,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+
+class Trace:
+    """A run/request-scoped id with nested spans and point events.
+
+    ``record=False`` makes a buffer-only trace (events collect in
+    ``.events`` without touching the recorder) — the microbatcher's
+    per-batch trace, whose events are ``adopt()``-ed into each
+    participating request's recording trace afterwards.
+    """
+
+    def __init__(
+        self,
+        recorder: TraceRecorder,
+        trace_id: str | None = None,
+        name: str = "",
+        record: bool = True,
+        enabled: bool | None = None,
+    ):
+        self.recorder = recorder
+        self.id = trace_id or uuid.uuid4().hex[:12]
+        self.name = name
+        self.events: list[dict] = []
+        self.record = record
+        self.enabled = recorder.spans_enabled if enabled is None else bool(enabled)
+        # span parentage is per-thread: a trace may be touched from several
+        # threads (submit on a handler thread, dispatch on the flusher) and
+        # their span stacks must not interleave
+        self._tls = threading.local()
+
+    # -- emission ------------------------------------------------------------
+    def _emit(self, ev: dict) -> None:
+        ev = {"trace": self.id, **ev}
+        self.events.append(ev)
+        if self.record:
+            self.recorder.emit(ev)
+
+    def _parent(self):
+        stack = getattr(self._tls, "stack", ())
+        return stack[-1] if stack else None
+
+    # -- spans ---------------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Timed nested span; yields the span id (None when disabled).
+
+        The span event is emitted at exit (one event per span, ``ts`` +
+        ``dur``), so a crash mid-span loses only the open span — the JSONL
+        sink stays parseable line by line.
+        """
+        if not self.enabled:
+            yield None
+            return
+        sid = next(_span_ids)
+        stack = getattr(self._tls, "stack", ())
+        parent = stack[-1] if stack else None
+        self._tls.stack = stack + (sid,)
+        t0 = self.recorder.now()
+        try:
+            yield sid
+        finally:
+            self._tls.stack = stack
+            ev = {
+                "kind": "span",
+                "name": name,
+                "span": sid,
+                "parent": parent,
+                "ts": round(t0, 6),
+                "dur": round(self.recorder.now() - t0, 6),
+            }
+            if attrs:
+                ev["attrs"] = attrs
+            self._emit(ev)
+
+    def record_span(
+        self, name: str, dur: float, parent=None, **attrs
+    ) -> int | None:
+        """A span whose duration was measured elsewhere (e.g. under the
+        batcher's injectable clock): recorded as ending now, ``dur`` seconds
+        long. Parent defaults to the calling thread's current span."""
+        if not self.enabled:
+            return None
+        sid = next(_span_ids)
+        now = self.recorder.now()
+        dur = max(float(dur), 0.0)
+        ev = {
+            "kind": "span",
+            "name": name,
+            "span": sid,
+            "parent": parent if parent is not None else self._parent(),
+            # clamped: a duration measured under a different clock (fake
+            # batcher clocks in tests) must not produce a pre-epoch start
+            "ts": round(max(now - dur, 0.0), 6),
+            "dur": round(dur, 6),
+        }
+        if attrs:
+            ev["attrs"] = attrs
+        self._emit(ev)
+        return sid
+
+    def event(self, name: str, **attrs) -> None:
+        """Point event under the calling thread's current span."""
+        if not self.enabled:
+            return
+        ev = {
+            "kind": "event",
+            "name": name,
+            "parent": self._parent(),
+            "ts": round(self.recorder.now(), 6),
+        }
+        if attrs:
+            ev["attrs"] = attrs
+        self._emit(ev)
+
+    # -- composition ---------------------------------------------------------
+    def adopt(self, other: "Trace", parent=None) -> None:
+        """Re-stamp ``other``'s events under this trace id (root events get
+        ``parent``). Span ids are process-unique, so no remapping needed."""
+        if not self.enabled:
+            return
+        for ev in other.events:
+            ev = dict(ev, trace=self.id)
+            if ev.get("parent") is None and parent is not None:
+                ev["parent"] = parent
+            self._emit(ev)
+
+    def tree(self) -> list[dict]:
+        """Nested JSON-ready span/event tree (children sorted by ts) — the
+        per-request payload ``/attack`` responses return."""
+        nodes: dict[int, dict] = {}
+        order: list[tuple[int | None, dict]] = []
+        for ev in self.events:
+            node = {
+                k: ev[k]
+                for k in ("kind", "name", "ts", "dur", "value", "attrs")
+                if k in ev
+            }
+            if ev.get("kind") == "span":
+                node["children"] = []
+                nodes[ev["span"]] = node
+            order.append((ev.get("parent"), node))
+        roots: list[dict] = []
+        for parent, node in order:
+            target = nodes.get(parent)
+            if target is not None and target is not node:
+                target["children"].append(node)
+            else:
+                roots.append(node)
+        for node in nodes.values():
+            node["children"].sort(key=lambda n: n.get("ts", 0.0))
+        roots.sort(key=lambda n: n.get("ts", 0.0))
+        return roots
+
+
+# -- ambient trace ----------------------------------------------------------
+def current_trace() -> Trace | None:
+    """The ambient trace installed by :func:`use_trace`, if any."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace: Trace | None):
+    """Install ``trace`` as the ambient trace for the dynamic extent."""
+    token = _current.set(trace)
+    try:
+        yield trace
+    finally:
+        _current.reset(token)
+
+
+def maybe_span(trace: Trace | None, name: str, **attrs):
+    """``trace.span(...)`` or a no-op context when tracing is off."""
+    if trace is not None and trace.enabled:
+        return trace.span(name, **attrs)
+    return contextlib.nullcontext()
+
+
+# -- process default + config hook -------------------------------------------
+#: the process default: counters/gauges always on, spans off, no sink —
+#: what every path uses when no ``system.trace_log`` is configured.
+_DEFAULT = TraceRecorder(spans_enabled=False)
+_SINKS: dict[str, TraceRecorder] = {}
+_SINKS_LOCK = threading.Lock()
+
+
+def default_recorder() -> TraceRecorder:
+    return _DEFAULT
+
+
+def recorder_for(config: dict | None) -> TraceRecorder:
+    """Config ``system.trace_log`` -> a sink-backed recorder (memoized per
+    path so every run in a process appends to one stream); absent -> the
+    process default (counters on, spans off)."""
+    path = (config or {}).get("system", {}).get("trace_log")
+    if not path:
+        return _DEFAULT
+    with _SINKS_LOCK:
+        rec = _SINKS.get(path)
+        if rec is None:
+            rec = _SINKS[path] = TraceRecorder(sink_path=path)
+        return rec
+
+
+# -- device memory watermarks -------------------------------------------------
+def device_memory_stats(device=None) -> dict | None:
+    """Best-effort HBM watermark of ``device`` (default: the first visible
+    device): ``{bytes_in_use, peak_bytes_in_use, ...}`` ints, or None when
+    the backend does not expose allocator stats (CPU) or JAX is not
+    initialised. Never raises — observability must not take a run down."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+        if not stats:
+            return None
+        out = {
+            k: int(stats[k])
+            for k in (
+                "bytes_in_use",
+                "peak_bytes_in_use",
+                "bytes_limit",
+                "largest_alloc_size",
+            )
+            if k in stats
+        }
+        return out or None
+    except Exception:
+        return None

@@ -49,6 +49,7 @@ from ..experiments.common import (  # noqa: F401 — BucketMenu/RequestTooLarge
     RequestTooLarge,  # compaction path all consume ONE size source of truth
     pad_states,
 )
+from ..observability import Trace, use_trace
 
 
 class QueueFull(Exception):
@@ -80,6 +81,9 @@ class _Pending:
     enqueued_at: float
     deadline_at: float | None
     meta: dict
+    #: the request's observability trace (None when tracing is off — the
+    #: batcher then does zero trace work for this request)
+    trace: Trace | None = None
 
 
 @dataclass
@@ -135,12 +139,15 @@ class Microbatcher:
         *,
         deadline_s: float | None = None,
         meta: dict | None = None,
+        trace: Trace | None = None,
     ) -> Future:
         """Queue ``rows`` under ``key``; resolves to ``(result_rows, meta)``.
 
         ``dispatch`` is the key's batch function (first submit wins; all
         requests under one key must share it — the service guarantees this
-        by deriving the key from everything the closure captures).
+        by deriving the key from everything the closure captures). ``trace``
+        (optional) receives the request's queue_wait/batch spans and rides
+        back in the result meta as a span tree.
         """
         rows = np.asarray(rows)
         n = rows.shape[0]
@@ -159,6 +166,7 @@ class Microbatcher:
             enqueued_at=now,
             deadline_at=None if deadline_s is None else now + float(deadline_s),
             meta=dict(meta or {}),
+            trace=trace,
         )
         with self._cond:
             if self._stop:
@@ -222,6 +230,12 @@ class Microbatcher:
             if p.deadline_at is not None and p.deadline_at <= now:
                 if self.metrics:
                     self.metrics.count("timeouts")
+                if p.trace is not None:
+                    p.trace.event(
+                        "cancelled",
+                        reason="deadline",
+                        queued_s=round(now - p.enqueued_at, 6),
+                    )
                 p.future.set_exception(
                     DeadlineExceeded(
                         f"deadline passed after {now - p.enqueued_at:.3f}s in "
@@ -252,22 +266,26 @@ class Microbatcher:
                 if self._due(key, q, now, force):
                     batch, rows_total = self._assemble(key, q, now)
                     if batch:
-                        todo.append((key, q.dispatch, batch, rows_total))
+                        todo.append((key, q.dispatch, batch, rows_total, now))
                 # drop drained queues: the key space is client-controlled
                 # (ε sweeps), so idle keys must not accumulate flusher work
                 if not q.requests:
                     del self._queues[key]
             if self.metrics:
                 self.metrics.gauge("queue_depth_rows", self._rows_total)
-        for key, dispatch, batch, rows_total in todo:
-            self._dispatch(key, dispatch, batch, rows_total)
+        for key, dispatch, batch, rows_total, t_asm in todo:
+            self._dispatch(key, dispatch, batch, rows_total, t_asm)
         return len(todo)
 
-    def _dispatch(self, key, dispatch, batch: list[_Pending], rows_total: int):
+    def _dispatch(
+        self, key, dispatch, batch: list[_Pending], rows_total: int, t_asm: float
+    ):
         with self._dispatch_lock:
-            self._dispatch_one(key, dispatch, batch, rows_total)
+            self._dispatch_one(key, dispatch, batch, rows_total, t_asm)
 
-    def _dispatch_one(self, key, dispatch, batch: list[_Pending], rows_total: int):
+    def _dispatch_one(
+        self, key, dispatch, batch: list[_Pending], rows_total: int, t_asm: float
+    ):
         bucket = self.menu.bucket_for(rows_total)
         with self._lock:
             self._batch_seq += 1
@@ -278,9 +296,30 @@ class Microbatcher:
             else np.concatenate([p.rows for p in batch], axis=0)
         )
         x_pad, _ = pad_states(x, None, bucket=bucket)
+        # per-batch trace: only built when at least one batch-mate is traced
+        # (tracing off => this whole block is two attribute reads). It is
+        # buffer-only (record=False); its spans are adopted into each traced
+        # request's own trace after the dispatch, so device work appears in
+        # every request's span tree under the request's id.
+        bt = None
+        for p in batch:
+            if p.trace is not None and p.trace.enabled:
+                bt = Trace(
+                    p.trace.recorder, trace_id=f"batch-{seq}", record=False
+                )
+                break
         t0 = self.clock()
         try:
-            out = np.asarray(dispatch(x_pad))
+            if bt is None:
+                out = np.asarray(dispatch(x_pad))
+            else:
+                with use_trace(bt), bt.span(
+                    "dispatch",
+                    bucket=bucket,
+                    rows=rows_total,
+                    requests=len(batch),
+                ):
+                    out = np.asarray(dispatch(x_pad))
             if out.shape[0] != bucket:
                 raise ValueError(
                     f"dispatch returned leading axis {out.shape[0]}, "
@@ -291,6 +330,8 @@ class Microbatcher:
                 self.metrics.count("batch_failures")
             err = BatchExecutionError(key, e)
             for p in batch:
+                if p.trace is not None:
+                    p.trace.event("batch_failed", batch_seq=seq, error=repr(e))
                 p.future.set_exception(err)
             return
         dt = self.clock() - t0
@@ -313,6 +354,17 @@ class Microbatcher:
                 queued_s=round(t0 - p.enqueued_at, 6),
                 dispatch_s=round(dt, 6),
             )
+            if p.trace is not None and p.trace.enabled:
+                # the request's own waits (batcher clock), then the shared
+                # batch spans re-stamped under the request's trace id — one
+                # correlated tree per request
+                p.trace.record_span(
+                    "queue_wait", max(t_asm - p.enqueued_at, 0.0)
+                )
+                p.trace.record_span("batch_wait", max(t0 - t_asm, 0.0))
+                if bt is not None:
+                    p.trace.adopt(bt)
+                meta["trace"] = p.trace.tree()
             p.future.set_result((out[off : off + p.n].copy(), meta))
             off += p.n
 

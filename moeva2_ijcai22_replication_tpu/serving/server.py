@@ -9,9 +9,13 @@ Three routes, no dependencies beyond ``http.server``:
   than the biggest bucket, 429 + ``Retry-After`` on backpressure, 504 on a
   queued deadline or server-side wait timeout, 500 when the request's batch
   failed.
-- ``GET /healthz`` — liveness + queue depth.
+- ``GET /healthz`` — liveness + queue depth + build/config identity (git
+  describe, config hash, per-domain mesh description) so load balancers can
+  detect a mis-deployed or mis-meshed replica.
 - ``GET /metrics`` — the :class:`~..utils.observability.ServiceMetrics`
-  snapshot plus engine/artifact cache stats, JSON.
+  snapshot plus engine/artifact cache stats, JSON;
+  ``GET /metrics?format=prom`` serves the same numbers as Prometheus text
+  exposition (``observability.prom``).
 
 ``ThreadingHTTPServer`` gives one handler thread per connection; handlers
 block on the request future while the single flusher/dispatch thread keeps
@@ -25,7 +29,9 @@ import json
 import math
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
+from ..observability.prom import prometheus_text
 from .batcher import BatchExecutionError, DeadlineExceeded, QueueFull, RequestTooLarge
 from .service import AttackRequest, AttackService, InvalidRequest
 
@@ -58,6 +64,14 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, body: str, content_type: str):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def log_message(self, fmt, *args):
         if self.server.verbose:
             super().log_message(fmt, *args)
@@ -65,10 +79,19 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
     # -- routes --------------------------------------------------------------
     def do_GET(self):
         service = self.server.service
-        if self.path == "/healthz":
+        parts = urlsplit(self.path)
+        if parts.path == "/healthz":
             self._send(200, service.healthz())
-        elif self.path == "/metrics":
-            self._send(200, service.metrics_snapshot())
+        elif parts.path == "/metrics":
+            query = parse_qs(parts.query)
+            if query.get("format", [""])[0] == "prom":
+                self._send_text(
+                    200,
+                    prometheus_text(service.metrics_snapshot()),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._send(200, service.metrics_snapshot())
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

@@ -40,6 +40,14 @@ import numpy as np
 from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sharding import describe_mesh
 from ..experiments import common
+from ..observability import (
+    Trace,
+    TraceRecorder,
+    build_identity,
+    current_trace,
+    device_memory_stats,
+    maybe_span,
+)
 from ..utils.config import get_dict_hash
 from ..utils.observability import ServiceMetrics
 from .batcher import BucketMenu, Microbatcher
@@ -47,6 +55,23 @@ from .batcher import BucketMenu, Microbatcher
 
 class InvalidRequest(ValueError):
     """The request can never succeed (unknown domain, bad shape, bad family)."""
+
+
+def _record_device_span(bt, engine, traces0: int, t0: float, **extra) -> None:
+    """The one device-span shape both dispatch closures emit: compile vs run
+    split by the engine's ``trace_count`` delta, HBM watermark attached."""
+    if bt is None:
+        return
+    traced = engine.trace_count - traces0
+    bt.record_span(
+        "device_compile" if traced else "device_run",
+        time.perf_counter() - t0,
+        traces=int(traced),
+        hbm=device_memory_stats(
+            engine.mesh.devices.flat[0] if engine.mesh is not None else None
+        ),
+        **extra,
+    )
 
 
 @dataclass
@@ -104,14 +129,32 @@ class AttackService:
         max_queue_rows: int = 4096,
         seed: int = 42,
         metrics: ServiceMetrics | None = None,
+        metrics_window: int = 8192,
+        recorder=None,
         stream=None,
         clock: Callable[[], float] | None = None,
         start: bool = True,
     ):
         self.domains = dict(domains)
         self.seed = int(seed)
-        self.metrics = metrics if metrics is not None else ServiceMetrics()
+        # the unified tracing recorder: counters always mirror into it; when
+        # its spans are enabled (``serving.trace_log`` / an explicit
+        # TraceRecorder(spans_enabled=True)), every request gets a
+        # correlated trace covering validate -> queue_wait -> batch ->
+        # device -> decode, returned in the response meta. Default is a
+        # counters-only recorder OWNED by this service (not the process
+        # default): record telemetry must report this service's activity,
+        # not whatever else instrumented the process
+        self.recorder = (
+            recorder if recorder is not None else TraceRecorder(spans_enabled=False)
+        )
+        self.metrics = (
+            metrics
+            if metrics is not None
+            else ServiceMetrics(window=metrics_window, recorder=self.recorder)
+        )
         self.stream = stream
+        self._build = build_identity(self.domains)
         self.clock = clock or time.monotonic
         self.menu = BucketMenu(bucket_sizes)
         self.batcher = Microbatcher(
@@ -235,18 +278,26 @@ class AttackService:
             )
 
             def dispatch(x_batch: np.ndarray) -> np.ndarray:
+                # the ambient per-batch trace the microbatcher installed
+                # around this call (None when tracing is off)
+                bt = current_trace()
                 # the poisoned-batch isolation boundary: a constraint-invalid
                 # row fails the whole bucket here, before any device work
                 constraints.check_constraints_error(x_batch)
                 traces0 = engine.trace_count
                 x_scaled = np.asarray(scaler.transform(x_batch))
                 y = np.asarray(surrogate.predict_proba(x_scaled)).argmax(-1)
+                t0 = time.perf_counter()
                 x_adv = engine.generate(
                     x_scaled, y, eps=eps_run, eps_step=eps_step, max_iter=budget
                 )
                 self.metrics.count("compiles", engine.trace_count - traces0)
-                x_adv = np.asarray(scaler.inverse(x_adv))
-                return round_ints_toward_initial(x_adv, x_batch, feature_types)
+                _record_device_span(bt, engine, traces0, t0)
+                with maybe_span(bt, "decode"):
+                    x_adv = np.asarray(scaler.inverse(x_adv))
+                    return round_ints_toward_initial(
+                        x_adv, x_batch, feature_types
+                    )
 
             chunk = None
         else:  # moeva
@@ -265,6 +316,7 @@ class AttackService:
             es_eps = float(pseudo.get("early_stop_eps", np.inf))
 
             def dispatch(x_batch: np.ndarray) -> np.ndarray:
+                bt = current_trace()
                 constraints.check_constraints_error(x_batch)
                 traces0 = engine.trace_count
                 # host-side dispatch knobs, per the engine-cache contract
@@ -274,9 +326,21 @@ class AttackService:
                 engine.early_stop_threshold = es_threshold
                 engine.early_stop_eps = es_eps
                 engine.compaction_buckets = self.menu.sizes
-                result = engine.generate(x_batch, 1)
+                # the engine's gate progress events (generation index,
+                # success fraction, active set, HBM) land in the batch trace
+                engine.trace = bt
+                t0 = time.perf_counter()
+                try:
+                    result = engine.generate(x_batch, 1)
+                finally:
+                    engine.trace = None
                 self.metrics.count("compiles", engine.trace_count - traces0)
-                return np.asarray(result.x_ml)
+                _record_device_span(
+                    bt, engine, traces0, t0,
+                    gens_executed=int(result.gens_executed),
+                )
+                with maybe_span(bt, "decode"):
+                    return np.asarray(result.x_ml)
 
             chunk = engine.effective_states_chunk()
 
@@ -339,9 +403,23 @@ class AttackService:
         :class:`~.batcher.RequestTooLarge` synchronously; queued failures
         (deadline, batch errors) surface through the future.
         """
-        res = self.resolve(req)
-        x = self._validate(req, res)
         rid = req.request_id or uuid.uuid4().hex[:12]
+        # request-scoped trace (None when spans are off — the whole request
+        # path then does no trace work at all, the overhead contract)
+        trace = (
+            Trace(
+                self.recorder,
+                trace_id=f"req-{rid}",
+                name=f"{req.attack}/{req.domain}",
+            )
+            if self.recorder.spans_enabled
+            else None
+        )
+        with maybe_span(
+            trace, "validate", domain=req.domain, attack=req.attack
+        ):
+            res = self.resolve(req)
+            x = self._validate(req, res)
         t_submit = self.clock()
         fut = self.batcher.submit(
             res.key,
@@ -355,6 +433,7 @@ class AttackService:
                 bit_identical=res.bit_identical,
                 execution=res.execution,
             ),
+            trace=trace,
         )
 
         def _done(f):
@@ -362,6 +441,14 @@ class AttackService:
             ok = f.exception() is None
             self.metrics.observe("latency_s", latency)
             self.metrics.count("completed" if ok else "failed")
+            if trace is not None:
+                # end-to-end marker in the event stream (the span tree in
+                # the response meta was already assembled at dispatch time)
+                trace.event(
+                    "request_done",
+                    status="ok" if ok else type(f.exception()).__name__,
+                    latency_s=round(latency, 6),
+                )
             if self.stream is not None:
                 self.stream.log_event(
                     "request",
@@ -411,12 +498,34 @@ class AttackService:
 
     # -- introspection -------------------------------------------------------
     def healthz(self) -> dict:
+        # mesh identity per domain: the configured device count always, plus
+        # the actual `describe_mesh` once a request resolved the domain — a
+        # load balancer comparing replicas can catch a mis-meshed one before
+        # (and after) it takes traffic
+        meshes = {
+            name: {
+                "mesh_devices": int(
+                    (cfg.get("system") or {}).get("mesh_devices", 0) or 0
+                ),
+                "mesh": None,
+                "resolved": False,
+            }
+            for name, cfg in self.domains.items()
+        }
+        with self._lock:
+            resolved = list(self._resolved.values())
+        for res in resolved:
+            entry = meshes.get(res.meta["domain"])
+            if entry is not None:
+                entry["mesh"] = res.execution["mesh"]
+                entry["resolved"] = True
         return {
             "ok": True,
             "uptime_s": round(time.time() - self._t0, 3),
             "domains": sorted(self.domains),
             "queue_depth_rows": self.batcher.queue_depth_rows(),
             "bucket_menu": list(self.menu.sizes),
+            "build": dict(self._build, meshes=meshes),
         }
 
     def metrics_snapshot(self) -> dict:
@@ -424,6 +533,10 @@ class AttackService:
         snap["engine_cache"] = common.ENGINES.stats()
         snap["artifact_cache"] = common.ARTIFACTS.stats()
         snap["resolved_run_configs"] = len(self._resolved)
+        snap["trace"] = {
+            "spans_enabled": self.recorder.spans_enabled,
+            "events_emitted": self.recorder.events_emitted,
+        }
         return snap
 
     def close(self):

@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Sequence
 
+from ..observability import telemetry_block, validate_record
 from ..utils.observability import percentile
 from .batcher import DeadlineExceeded, QueueFull, RequestTooLarge
 from .service import AttackRequest, AttackService
@@ -129,12 +130,23 @@ def offered_load_sweep(
         for rps in offered_rps_levels
     ]
     snap = service.metrics_snapshot()
-    return {
-        "bucket_menu": list(service.menu.sizes),
-        "max_delay_s": service.batcher.max_delay_s,
-        "levels": levels,
-        "counters": snap["counters"],
-        "engine_cache": snap["engine_cache"],
-        "latency": snap["streams"].get("latency_s"),
-        "batch_occupancy": snap["streams"].get("batch_occupancy"),
-    }
+    return validate_record(
+        {
+            "bucket_menu": list(service.menu.sizes),
+            "max_delay_s": service.batcher.max_delay_s,
+            "levels": levels,
+            "counters": snap["counters"],
+            "engine_cache": snap["engine_cache"],
+            "latency": snap["streams"].get("latency_s"),
+            "batch_occupancy": snap["streams"].get("batch_occupancy"),
+            # the shared record schema every bench/grid/serving record
+            # carries (observability.records)
+            "execution": {
+                "bucket_menu": list(service.menu.sizes),
+                "max_delay_s": service.batcher.max_delay_s,
+                "resolved_run_configs": snap["resolved_run_configs"],
+            },
+            "telemetry": telemetry_block(recorder=service.recorder),
+        },
+        "serving",
+    )

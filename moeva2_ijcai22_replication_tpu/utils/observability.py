@@ -16,6 +16,14 @@ clock includes tracing + XLA compilation or a persistent-cache load) and in
 ``attack_run`` when it re-used an executable. The grid report sums these
 across points, which is what makes executable reuse visible: a healthy
 ε-sweep shows one ``attack_compile`` span and N-1 ``attack_run`` spans.
+
+Both classes are thin facades over the unified tracing subsystem
+(``..observability``): a :class:`PhaseTimer` built with a ``trace`` also
+emits each span into that run's id-correlated event stream, and a
+:class:`ServiceMetrics` built with a ``recorder`` mirrors its counters and
+gauges there — grid reports, bench records, and serving metadata share one
+recorder. Spans are measured with ``time.perf_counter()`` (monotonic):
+wall-clock steps under NTP adjustment must not corrupt a span.
 """
 
 from __future__ import annotations
@@ -27,23 +35,27 @@ import time
 
 
 class PhaseTimer:
-    """Named wall-clock spans + counters; ``.spans``/``.counters`` are
-    JSON-ready."""
+    """Named monotonic-clock spans + counters; ``.spans``/``.counters`` are
+    JSON-ready. With a ``trace`` (``observability.Trace``), every span also
+    lands in the unified event stream under that run's id."""
 
-    def __init__(self):
+    def __init__(self, trace=None):
         self.spans: dict[str, float] = {}
         self.counters: dict[str, int] = {}
+        self.trace = trace
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.add(name, time.time() - t0)
+            self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float):
         self.spans[name] = self.spans.get(name, 0.0) + seconds
+        if self.trace is not None:
+            self.trace.record_span(name, seconds)
 
     def count(self, name: str, n: int = 1):
         self.counters[name] = self.counters.get(name, 0) + n
@@ -54,11 +66,11 @@ class PhaseTimer:
         ``{name}_compile`` / ``{name}_run`` by whether ``engine`` traced a
         new program during the call, and counting the traces."""
         traces0 = getattr(engine, "trace_count", 0)
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             yield
         finally:
-            dt = time.time() - t0
+            dt = time.perf_counter() - t0
             traced = getattr(engine, "trace_count", 0) - traces0
             self.add(name, dt)
             self.add(f"{name}_compile" if traced else f"{name}_run", dt)
@@ -87,12 +99,16 @@ class ServiceMetrics:
     record, and per-response metadata. Streams keep the most recent
     ``window`` samples (quantiles reflect recent traffic, memory stays
     bounded) plus an unbounded count/sum so rates and means never lose
-    history.
+    history. With a ``recorder`` (``observability.TraceRecorder``), counters
+    and gauges are mirrored into the unified stream — the always-on cheap
+    instruments of the tracing contract; sample streams stay local (they
+    are bounded, quantile-shaped state, not events).
     """
 
-    def __init__(self, window: int = 8192):
+    def __init__(self, window: int = 8192, recorder=None):
         self._lock = threading.Lock()
         self._window = window
+        self.recorder = recorder
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, float] = {}
         self._samples: dict[str, collections.deque] = {}
@@ -101,10 +117,14 @@ class ServiceMetrics:
     def count(self, name: str, n: int = 1):
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+        if self.recorder is not None:
+            self.recorder.count(name, n)
 
     def gauge(self, name: str, value: float):
         with self._lock:
             self.gauges[name] = value
+        if self.recorder is not None:
+            self.recorder.gauge(name, value)
 
     def observe(self, name: str, value: float):
         with self._lock:

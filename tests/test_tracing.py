@@ -1,0 +1,671 @@
+"""Unified tracing & telemetry subsystem tests.
+
+Core (hardware-free): span nesting/ids and per-thread parentage, explicit-
+duration spans, the buffer-only/adopt composition the microbatcher uses,
+ring-buffer bounding, always-on counters vs opt-in spans, JSONL round-trip
+through the Perfetto exporter (library + ``tools/trace_export.py`` CLI),
+and Prometheus text exposition.
+
+Serving (tier-1 acceptance): one request driven through a traced
+``AttackService`` yields a single correlated span tree covering
+validate -> queue_wait -> batch_wait -> dispatch -> device -> decode,
+exportable to valid Chrome/Perfetto trace-event JSON — and the overhead
+smoke proves tracing-off is a no-op (zero span events, zero extra
+dispatches) while tracing-on adds no compiles and leaves results
+bit-identical.
+
+Plus the satellite contracts: PhaseTimer spans survive wall-clock steps
+(perf_counter), ServiceMetrics mirrors into the recorder, ``/healthz``
+carries build/config identity, and the shared record schema
+(``execution`` + ``telemetry``) is enforced at every record producer.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.observability import (
+    REQUIRED_RECORD_KEYS,
+    Trace,
+    TraceRecorder,
+    build_identity,
+    current_trace,
+    device_memory_stats,
+    maybe_span,
+    recorder_for,
+    telemetry_block,
+    use_trace,
+    validate_record,
+)
+from moeva2_ijcai22_replication_tpu.observability.export import (
+    read_jsonl,
+    to_chrome_trace,
+)
+from moeva2_ijcai22_replication_tpu.observability.prom import prometheus_text
+from moeva2_ijcai22_replication_tpu.utils.observability import (
+    PhaseTimer,
+    ServiceMetrics,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# trace core
+# ---------------------------------------------------------------------------
+
+
+class TestTraceCore:
+    def test_span_nesting_ids_and_events(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec, trace_id="t1")
+        with t.span("outer") as outer_id:
+            with t.span("inner", k=1) as inner_id:
+                t.event("tick", x=2)
+        assert outer_id != inner_id
+        by_name = {e["name"]: e for e in t.events}
+        assert by_name["outer"]["parent"] is None
+        assert by_name["inner"]["parent"] == outer_id
+        assert by_name["inner"]["attrs"] == {"k": 1}
+        assert by_name["tick"]["parent"] == inner_id
+        assert all(e["trace"] == "t1" for e in t.events)
+        assert all(e["dur"] >= 0 for e in t.events if e["kind"] == "span")
+        # same events landed in the recorder ring
+        assert [e["name"] for e in rec.events()] == ["tick", "inner", "outer"]
+
+    def test_tree_nests_children_in_ts_order(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec)
+        with t.span("root"):
+            with t.span("a"):
+                pass
+            with t.span("b"):
+                t.event("e")
+        (root,) = t.tree()
+        assert root["name"] == "root"
+        assert [c["name"] for c in root["children"]] == ["a", "b"]
+        assert [c["name"] for c in root["children"][1]["children"]] == ["e"]
+
+    def test_record_span_explicit_duration_parents_under_current(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec)
+        with t.span("dispatch") as did:
+            t.record_span("device_run", 0.25, traces=0)
+        dev = next(e for e in t.events if e["name"] == "device_run")
+        assert dev["parent"] == did
+        assert dev["dur"] == 0.25
+        assert dev["ts"] >= 0
+
+    def test_disabled_recorder_spans_are_noops_counters_stay_on(self):
+        rec = TraceRecorder(spans_enabled=False)
+        t = Trace(rec)
+        with t.span("x") as sid:
+            t.event("y")
+        assert sid is None
+        assert t.events == [] and rec.events() == []
+        assert rec.events_emitted == 0
+        rec.count("requests", 2)
+        rec.gauge("depth", 7)
+        assert rec.counters["requests"] == 2 and rec.gauges["depth"] == 7.0
+        # gauges emit no events while spans are off
+        assert rec.events() == []
+
+    def test_gauge_emits_counter_event_when_spans_enabled(self):
+        rec = TraceRecorder(spans_enabled=True)
+        rec.gauge("queue_depth", 3)
+        (ev,) = rec.events()
+        assert ev["kind"] == "gauge" and ev["value"] == 3.0
+
+    def test_ring_buffer_bounded_but_count_unbounded(self):
+        rec = TraceRecorder(capacity=8, spans_enabled=True)
+        t = Trace(rec)
+        for i in range(20):
+            t.event(f"e{i}")
+        assert len(rec.events()) == 8
+        assert rec.events_emitted == 20
+        assert [e["name"] for e in rec.events()] == [
+            f"e{i}" for i in range(12, 20)
+        ]
+
+    def test_adopt_restamps_buffer_only_trace(self):
+        rec = TraceRecorder(spans_enabled=True)
+        batch = Trace(rec, trace_id="batch-1", record=False)
+        with batch.span("dispatch"):
+            batch.record_span("device_run", 0.1)
+        assert rec.events() == []  # buffer-only: nothing recorded yet
+        req = Trace(rec, trace_id="req-1")
+        root = req.record_span("queue_wait", 0.01)
+        req.adopt(batch, parent=root)
+        assert {e["trace"] for e in rec.events()} == {"req-1"}
+        names = {e["name"] for e in rec.events()}
+        assert names == {"queue_wait", "dispatch", "device_run"}
+        # the adopted dispatch span hangs under the request's root
+        dispatch = next(e for e in req.events if e["name"] == "dispatch")
+        assert dispatch["parent"] == root
+
+    def test_ambient_trace_helpers(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec)
+        assert current_trace() is None
+        with use_trace(t):
+            assert current_trace() is t
+            with maybe_span(current_trace(), "s"):
+                pass
+        assert current_trace() is None
+        assert [e["name"] for e in t.events] == ["s"]
+        # maybe_span on None is a no-op context
+        with maybe_span(None, "nothing"):
+            pass
+
+    def test_recorder_for_config_and_default(self, tmp_path):
+        assert recorder_for(None) is recorder_for({})
+        assert not recorder_for({}).spans_enabled
+        path = str(tmp_path / "t.jsonl")
+        rec = recorder_for({"system": {"trace_log": path}})
+        assert rec.spans_enabled and rec.sink_path == path
+        # memoized per path: every run in the process appends to one stream
+        assert recorder_for({"system": {"trace_log": path}}) is rec
+
+    def test_device_memory_stats_never_raises(self):
+        # CPU backend exposes no allocator stats -> None; must not raise
+        assert device_memory_stats() is None or isinstance(
+            device_memory_stats(), dict
+        )
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink -> Perfetto export (library + CLI)
+# ---------------------------------------------------------------------------
+
+
+class TestJsonlExport:
+    def _sink(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        rec = TraceRecorder(sink_path=path)
+        t = Trace(rec, trace_id="run-abc")
+        with t.span("attack", eps=0.2):
+            with t.span("device"):
+                t.event("moeva.gate", gen=10, active=4)
+        rec.gauge("grid_writer_queue_depth", 2)
+        rec.close()
+        return path
+
+    def test_jsonl_roundtrip_to_chrome_trace(self, tmp_path):
+        path = self._sink(tmp_path)
+        events = read_jsonl(path)
+        assert events[0]["kind"] == "meta" and "t0_wall" in events[0]
+        doc = to_chrome_trace(events)
+        json.loads(json.dumps(doc))  # strictly serializable
+        tevs = doc["traceEvents"]
+        spans = [e for e in tevs if e["ph"] == "X"]
+        assert {e["name"] for e in spans} == {"attack", "device"}
+        assert all(
+            isinstance(e["ts"], float) and e["dur"] >= 0 for e in spans
+        )
+        (inst,) = [e for e in tevs if e["ph"] == "i"]
+        assert inst["name"] == "moeva.gate" and inst["args"]["gen"] == 10
+        (counter,) = [e for e in tevs if e["ph"] == "C"]
+        assert counter["args"]["value"] == 2.0
+        # all events of one trace share one pid; its process_name metadata
+        # names the trace id
+        pids = {e["pid"] for e in spans}
+        assert len(pids) == 1
+        names = [
+            e
+            for e in tevs
+            if e["ph"] == "M" and e["args"]["name"] == "run-abc"
+        ]
+        assert len(names) == 1 and names[0]["pid"] in pids
+
+    def test_cli_tool(self, tmp_path):
+        import importlib.util
+
+        path = self._sink(tmp_path)
+        spec = importlib.util.spec_from_file_location(
+            "trace_export_cli", os.path.join(REPO, "tools", "trace_export.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = str(tmp_path / "out.json")
+        assert mod.main([path, "-o", out]) == 0
+        with open(out) as fh:
+            doc = json.load(fh)
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheus:
+    def test_counters_gauges_summaries_and_extras(self):
+        m = ServiceMetrics(window=16)
+        m.count("requests", 5)
+        m.count("batch_failures")
+        m.gauge("queue_depth_rows", 12)
+        for v in (0.1, 0.2, 0.3):
+            m.observe("latency_s", v)
+        snap = m.snapshot()
+        snap["engine_cache"] = {"hits": 3, "misses": 1}
+        snap["resolved_run_configs"] = 2
+        text = prometheus_text(snap)
+        assert "# TYPE moeva2_requests_total counter" in text
+        assert "moeva2_requests_total 5" in text
+        assert "# TYPE moeva2_queue_depth_rows gauge" in text
+        assert 'moeva2_latency_s{quantile="0.5"} 0.2' in text
+        assert "moeva2_latency_s_count 3" in text
+        assert "moeva2_engine_cache_hits 3" in text
+        assert "moeva2_resolved_run_configs 2" in text
+        # every sample line parses as `name[{labels}] <float>`
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name, value = line.rsplit(" ", 1)
+            assert name and float(value) == float(value)  # no NaN leakage
+
+    def test_empty_stream_renders_zero_sum(self):
+        m = ServiceMetrics()
+        text = prometheus_text(m.snapshot())
+        assert text.endswith("\n")
+
+
+# ---------------------------------------------------------------------------
+# facades (satellites): perf_counter timing + recorder mirroring
+# ---------------------------------------------------------------------------
+
+
+class TestFacades:
+    def test_phase_timer_survives_wall_clock_steps(self, monkeypatch):
+        # simulate an NTP step: time.time jumps backwards mid-span; spans
+        # are perf_counter-based so the recorded duration stays sane
+        steps = iter([1e9, 12.0, -5.0])
+        monkeypatch.setattr(time, "time", lambda: next(steps, -1.0))
+        timer = PhaseTimer()
+        with timer.phase("x"):
+            pass
+        assert 0 <= timer.spans["x"] < 10
+
+    def test_attack_split_survives_wall_clock_steps(self, monkeypatch):
+        steps = iter([1e9, -1e9])
+        monkeypatch.setattr(time, "time", lambda: next(steps, 0.0))
+
+        class Engine:
+            trace_count = 0
+
+        timer = PhaseTimer()
+        with timer.attack(Engine()):
+            pass
+        assert 0 <= timer.spans["attack_run"] < 10
+
+    def test_phase_timer_emits_into_trace(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec, trace_id="run-1")
+        timer = PhaseTimer(trace=t)
+        with timer.phase("setup"):
+            pass
+
+        class Engine:
+            trace_count = 0
+
+            def bump(self):
+                self.trace_count += 1
+
+        eng = Engine()
+        with timer.attack(eng):
+            eng.bump()
+        names = [e["name"] for e in t.events]
+        assert "setup" in names and "attack" in names
+        assert "attack_compile" in names  # the dispatch traced
+        assert timer.counters["traces"] == 1
+
+    def test_service_metrics_mirror_into_recorder(self):
+        rec = TraceRecorder(spans_enabled=False)
+        m = ServiceMetrics(recorder=rec)
+        m.count("requests", 3)
+        m.gauge("depth", 4)
+        m.observe("latency_s", 0.1)  # streams stay local
+        assert rec.counters == {"requests": 3}
+        assert rec.gauges == {"depth": 4.0}
+
+
+# ---------------------------------------------------------------------------
+# shared record schema
+# ---------------------------------------------------------------------------
+
+
+class TestRecordSchema:
+    def test_validate_record_rejects_missing_keys(self):
+        with pytest.raises(ValueError, match="telemetry"):
+            validate_record({"execution": {}}, "bench")
+        rec = {"execution": {}, "telemetry": {}}
+        assert validate_record(rec) is rec
+        assert set(REQUIRED_RECORD_KEYS) == {"execution", "telemetry"}
+
+    def test_telemetry_block_shape(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec)
+        t.event("e")
+        timer = PhaseTimer()
+        with timer.phase("setup"):
+            pass
+        block = telemetry_block(recorder=rec, timer=timer, trace=t)
+        assert block["events"] == 1 and block["trace_id"] == t.id
+        assert "setup" in block["spans_s"]
+        assert block["events_emitted"] == 1
+        assert "hbm" in block
+        json.dumps(block)  # JSON-ready
+
+    def test_grid_report_carries_schema_keys(self):
+        from moeva2_ijcai22_replication_tpu.experiments.pipeline import (
+            GridPipeline,
+        )
+
+        gp = GridPipeline(recorder=TraceRecorder(spans_enabled=False))
+        report = gp.finish({"seeds": [1], "system": {"mesh_devices": 0}}, [])
+        assert validate_record(report, "grid") is report
+        assert report["execution"]["pipeline"] is True
+        assert "hbm" in report["telemetry"]
+
+    def test_record_producers_keep_calling_the_validator(self):
+        """Repo check: the three record producers (bench, serving sweep,
+        grid pipeline) must keep assembling the shared schema through
+        observability.records — a refactor dropping the keys fails here
+        before it can silently drop them from committed records."""
+        producers = {
+            "bench.py": ("validate_record", "telemetry"),
+            "moeva2_ijcai22_replication_tpu/serving/sweep.py": (
+                "validate_record",
+                "telemetry_block",
+            ),
+            "moeva2_ijcai22_replication_tpu/experiments/pipeline.py": (
+                "validate_record",
+                "telemetry_block",
+            ),
+            # runner metrics embed the telemetry block next to `execution`
+            "moeva2_ijcai22_replication_tpu/experiments/moeva.py": (
+                "telemetry_block",
+            ),
+            "moeva2_ijcai22_replication_tpu/experiments/pgd.py": (
+                "telemetry_block",
+            ),
+        }
+        for fname, needles in producers.items():
+            with open(os.path.join(REPO, fname)) as fh:
+                src = fh.read()
+            for needle in needles:
+                assert needle in src, f"{fname} no longer references {needle}"
+
+    def test_build_identity(self):
+        ident = build_identity({"a": 1})
+        assert set(ident) >= {"git", "version", "config_hash"}
+        from moeva2_ijcai22_replication_tpu.utils.config import get_dict_hash
+
+        assert ident["config_hash"] == get_dict_hash({"a": 1})
+
+
+# ---------------------------------------------------------------------------
+# serving: traced request lifecycle + the tier-1 overhead smoke
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Synthetic-LCLD artifact family (same shape as test_serving's): the
+    tracing acceptance tests run dataset- and hardware-free."""
+    import joblib
+    from sklearn.preprocessing import MinMaxScaler
+
+    from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_lcld,
+        synth_lcld_schema,
+    )
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+
+    tmp = tmp_path_factory.mktemp("tracing_artifacts")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(64, cons.schema, seed=9)
+    cons.check_constraints_error(x)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=4))
+    save_params(sur, str(tmp / "nn.msgpack"))
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    joblib.dump(
+        MinMaxScaler().fit(np.vstack([x, xl, xu])), tmp / "scaler.joblib"
+    )
+    return {
+        "pool": x,
+        "domain": {
+            "project_name": "lcld",
+            "norm": 2,
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": paths["features"],
+                "constraints": paths["constraints"],
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "system": {"mesh_devices": 0},
+        },
+    }
+
+
+def make_service(artifacts, **kw):
+    from moeva2_ijcai22_replication_tpu.serving import AttackService
+
+    kw.setdefault("bucket_sizes", (8,))
+    kw.setdefault("max_delay_s", 0.01)
+    return AttackService({"lcld": artifacts["domain"]}, **kw)
+
+
+def _requests(artifacts, n=6, budget=2):
+    from moeva2_ijcai22_replication_tpu.serving import AttackRequest
+
+    pool = artifacts["pool"]
+    sizes = [1, 2, 3]
+    out = []
+    for i in range(n):
+        rows = sizes[i % len(sizes)]
+        start = (i * 7) % (pool.shape[0] - rows)
+        out.append(
+            AttackRequest(
+                domain="lcld",
+                x=pool[start : start + rows],
+                eps=0.2,
+                budget=budget,
+            )
+        )
+    return out
+
+
+def _span_names(tree):
+    names = set()
+    stack = list(tree)
+    while stack:
+        node = stack.pop()
+        names.add(node["name"])
+        stack.extend(node.get("children", ()))
+    return names
+
+
+class TestServingTraced:
+    def test_request_trace_covers_lifecycle_and_exports(self, artifacts):
+        """Acceptance: one request through AttackService yields a single
+        correlated trace covering queue_wait -> batch -> device -> decode,
+        exportable to valid Perfetto trace-event JSON."""
+        rec = TraceRecorder(spans_enabled=True)
+        svc = make_service(artifacts, recorder=rec)
+        try:
+            (req,) = _requests(artifacts, n=1)
+            resp = svc.attack(req, timeout=300.0)
+        finally:
+            svc.close()
+
+        tree = resp.meta["trace"]
+        names = _span_names(tree)
+        assert {
+            "validate",
+            "queue_wait",
+            "batch_wait",
+            "dispatch",
+            "decode",
+        } <= names
+        assert "device_compile" in names or "device_run" in names
+        # device + decode hang under the adopted dispatch span
+        dispatch = next(
+            n
+            for n in (t for t in tree)
+            if n["name"] == "dispatch"
+        )
+        children = {c["name"] for c in dispatch["children"]}
+        assert "decode" in children
+        assert children & {"device_compile", "device_run"}
+
+        # single correlated stream: every recorded event of this request
+        # carries the request's trace id
+        rid = resp.meta["request_id"]
+        req_events = [
+            e for e in rec.events() if e.get("trace") == f"req-{rid}"
+        ]
+        assert {"queue_wait", "dispatch"} <= {
+            e.get("name") for e in req_events
+        }
+
+        # exportable: valid Chrome/Perfetto trace-event JSON
+        doc = to_chrome_trace(rec.events())
+        json.loads(json.dumps(doc))
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+        # /metrics?format=prom serves the same counters in text exposition
+        text = prometheus_text(svc.metrics_snapshot())
+        assert "moeva2_requests_total 1" in text
+        assert "moeva2_batches_total 1" in text
+
+    def test_tracing_overhead_contract(self, artifacts):
+        """Tier-1 overhead smoke: tracing disabled is a no-op (zero span
+        events), and enabling it adds zero compiles, zero extra dispatches,
+        and leaves served numbers bit-identical."""
+        # 1) tracing OFF — the baseline; first service pays the compiles
+        rec_off = TraceRecorder(spans_enabled=False)
+        svc_off = make_service(artifacts, recorder=rec_off)
+        try:
+            resps_off = [
+                svc_off.attack(r, timeout=300.0) for r in _requests(artifacts)
+            ]
+        finally:
+            svc_off.close()
+        batches_off = svc_off.metrics.counters["batches"]
+        assert rec_off.events() == []  # no span/event work at all
+        assert all("trace" not in r.meta for r in resps_off)
+
+        # 2) tracing ON — same engines via the process-wide caches
+        rec_on = TraceRecorder(spans_enabled=True)
+        svc_on = make_service(artifacts, recorder=rec_on)
+        try:
+            resps_on = [
+                svc_on.attack(r, timeout=300.0) for r in _requests(artifacts)
+            ]
+        finally:
+            svc_on.close()
+
+        # no new compiled programs: tracing must not perturb shapes/keys
+        assert svc_on.metrics.counters.get("compiles", 0) == 0
+        # no extra dispatches for the same workload
+        assert svc_on.metrics.counters["batches"] == batches_off
+        # numerics untouched, bit for bit
+        for off_r, on_r in zip(resps_off, resps_on):
+            np.testing.assert_array_equal(off_r.x_adv, on_r.x_adv)
+        # and the traced run actually recorded the lifecycle
+        assert all("trace" in r.meta for r in resps_on)
+        assert rec_on.events_emitted > 0
+
+    def test_healthz_build_and_mesh_identity(self, artifacts):
+        svc = make_service(artifacts, start=False)
+        try:
+            health = svc.healthz()
+            build = health["build"]
+            assert set(build) >= {"git", "version", "config_hash", "meshes"}
+            from moeva2_ijcai22_replication_tpu.utils.config import (
+                get_dict_hash,
+            )
+
+            assert build["config_hash"] == get_dict_hash(svc.domains)
+            mesh = build["meshes"]["lcld"]
+            assert mesh == {
+                "mesh_devices": 0,
+                "mesh": None,
+                "resolved": False,
+            }
+        finally:
+            svc.close()
+
+
+class TestMoevaGateEvents:
+    def test_engine_emits_init_gate_done_events(self, tmp_path):
+        """The early-exit scan's between-gates visibility: per-gate progress
+        events (generation index, success fraction, active set, bucket) and
+        per-phase HBM watermarks land in the attached trace."""
+        import joblib  # noqa: F401 — parity with serving fixtures
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+        from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+        from moeva2_ijcai22_replication_tpu.domains.synth import (
+            synth_lcld,
+            synth_lcld_schema,
+        )
+        from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+        from moeva2_ijcai22_replication_tpu.models.mlp import (
+            init_params,
+            lcld_mlp,
+        )
+        from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+        paths = synth_lcld_schema(str(tmp_path))
+        cons = LcldConstraints(paths["features"], paths["constraints"])
+        x = synth_lcld(4, cons.schema, seed=3)
+        model = lcld_mlp()
+        sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=7))
+        rec = TraceRecorder(spans_enabled=True)
+        trace = Trace(rec, trace_id="run-gate-test")
+        moeva = Moeva2(
+            classifier=sur,
+            constraints=cons,
+            ml_scaler=fit_minmax(x.min(0), x.max(0)),
+            norm=2,
+            n_gen=5,
+            n_pop=8,
+            n_offsprings=4,
+            seed=11,
+            archive_size=2,
+            early_stop_check_every=2,
+            trace=trace,
+        )
+        moeva.generate(x, 1)
+        by_name = {}
+        for e in trace.events:
+            by_name.setdefault(e["name"], []).append(e)
+        assert "moeva.init" in by_name
+        assert by_name["moeva.init"][0]["attrs"]["states"] == 4
+        assert "moeva.gate" in by_name  # 4 scan steps, gate every 2
+        gate = by_name["moeva.gate"][0]["attrs"]
+        assert set(gate) >= {
+            "gen",
+            "active",
+            "parked",
+            "success_frac",
+            "bucket",
+            "hbm",
+        }
+        assert 0.0 <= gate["success_frac"] <= 1.0
+        (done,) = by_name["moeva.done"]
+        assert done["attrs"]["budget_gens"] == 4
+        # strict mode without a trace stays silent (and cannot crash)
+        moeva.trace = None
+        moeva.early_stop_check_every = 0
+        moeva.generate(x, 1)

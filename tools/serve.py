@@ -42,12 +42,26 @@ def main(argv=None) -> int:
     srv_cfg = cfg.get("serving", {})
     setup_jax_cache(cfg)
 
+    # request tracing: a JSONL sink enables spans — every /attack response
+    # then returns its own span tree and the stream renders in Perfetto via
+    # tools/trace_export.py. Off (the default) = counters only, no-op spans.
+    recorder = None
+    trace_log = srv_cfg.get("trace_log") or cfg.get("system", {}).get(
+        "trace_log"
+    )
+    if trace_log:
+        from moeva2_ijcai22_replication_tpu.observability import TraceRecorder
+
+        recorder = TraceRecorder(sink_path=trace_log)
+
     service = AttackService(
         cfg["domains"],
         bucket_sizes=srv_cfg.get("bucket_sizes", (8, 16, 32, 64, 128, 256)),
         max_delay_s=srv_cfg.get("max_delay_s", 0.01),
         max_queue_rows=srv_cfg.get("max_queue_rows", 4096),
         seed=srv_cfg.get("seed", 42),
+        metrics_window=srv_cfg.get("metrics_window", 8192),
+        recorder=recorder,
     )
     host = args.host or srv_cfg.get("host", "127.0.0.1")
     port = args.port if args.port is not None else srv_cfg.get("port", 8787)

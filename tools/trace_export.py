@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Render a trace JSONL sink to Chrome/Perfetto trace-event JSON.
+
+The unified tracing subsystem (``moeva2_ijcai22_replication_tpu/observability``)
+appends one JSON event per line to the path configured as
+``system.trace_log`` (runners/grids) or ``serving.trace_log`` (the HTTP
+front). This CLI converts that stream to the trace-event format the
+Perfetto UI (https://ui.perfetto.dev) and ``chrome://tracing`` open
+directly — one process track per trace id (request/run/batch), "X" slices
+for spans, instants for progress events (MoEvA gates), counter tracks for
+gauges (writer queue depth).
+
+    python tools/trace_export.py out/trace.jsonl
+    python tools/trace_export.py out/trace.jsonl -o trace.perfetto.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("path", help="trace JSONL file (system.trace_log)")
+    parser.add_argument(
+        "-o",
+        "--out",
+        default=None,
+        help="output path (default: <path>.perfetto.json)",
+    )
+    args = parser.parse_args(argv)
+
+    from moeva2_ijcai22_replication_tpu.observability.export import (
+        read_jsonl,
+        to_chrome_trace,
+    )
+
+    events = read_jsonl(args.path)
+    doc = to_chrome_trace(events)
+    out = args.out or f"{args.path}.perfetto.json"
+    with open(out, "w") as fh:
+        json.dump(doc, fh)
+    print(
+        f"{len(events)} trace events -> {len(doc['traceEvents'])} "
+        f"trace-event records -> {out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -317,7 +317,7 @@ def run_early_exit_bench() -> dict | None:
         x = pool[np.argsort(np.abs(p1 - threshold))[:s]]
 
         from moeva2_ijcai22_replication_tpu.observability import (
-            Trace, TraceRecorder, telemetry_block, validate_record,
+            Trace, TraceRecorder, get_ledger, telemetry_block, validate_record,
         )
 
         moeva = Moeva2(
@@ -329,6 +329,9 @@ def run_early_exit_bench() -> dict | None:
         # HBM) land in the record's telemetry block
         recorder = TraceRecorder(spans_enabled=True)
         moeva.trace = Trace(recorder, trace_id="bench-early-exit")
+        # cost window: this record reports the A/B's own executables, not
+        # whatever the rest of the bench invocation compiled
+        ledger_mark = get_ledger().mark()
 
         def timed(check_every):
             moeva.early_stop_check_every = check_every
@@ -381,7 +384,7 @@ def run_early_exit_bench() -> dict | None:
                 "gens_executed": int(early.gens_executed),
             },
             "telemetry": telemetry_block(
-                recorder=recorder, trace=moeva.trace
+                recorder=recorder, trace=moeva.trace, ledger_since=ledger_mark
             ),
         }
         validate_record(record, "early_exit")
@@ -598,11 +601,16 @@ def main():
     # device programs are identical with or without it)
     from moeva2_ijcai22_replication_tpu.attacks.sharding import describe_mesh
     from moeva2_ijcai22_replication_tpu.observability import (
-        Trace, TraceRecorder, telemetry_block, validate_record,
+        Trace, TraceRecorder, get_ledger, telemetry_block, validate_record,
     )
 
     bench_recorder = TraceRecorder(spans_enabled=True)
     moeva.trace = Trace(bench_recorder, trace_id="bench-headline")
+    # cost window for the headline record: opened here, closed right after
+    # the steady runs — the later sub-benchmarks (botnet/serving/early-exit)
+    # must not leak their executables into the headline's flops_total,
+    # which bench_diff uses as the steady_s work normalizer
+    headline_mark = get_ledger().mark()
 
     t0 = time.time()
     res = moeva.generate(x, minimize_class=1)
@@ -616,6 +624,9 @@ def main():
         res = moeva.generate(x, minimize_class=1)
         steady_runs.append(time.time() - t0)
     ours_s = min(steady_runs)
+    headline_telemetry = telemetry_block(
+        recorder=bench_recorder, trace=moeva.trace, ledger_since=headline_mark
+    )
     log(f"[bench] ours: {ours_s:.1f}s steady / {cold_s:.1f}s cold "
         f"(compile-or-cache-load {cold_s - ours_s:.1f}s) for "
         f"{N_STATES} states x {N_GEN} gens (pop {moeva.pop_size})")
@@ -705,11 +716,19 @@ def main():
             "n_states": N_STATES,
             "n_gen": N_GEN,
         },
-        "telemetry": telemetry_block(
-            recorder=bench_recorder, trace=moeva.trace
-        ),
+        # assembled right after the steady runs (see headline_mark): covers
+        # the headline executables only
+        "telemetry": headline_telemetry,
     }
     validate_record(record, "bench")
+    # the executable cost footprint of everything this bench dispatched —
+    # the series bench_diff normalizes against (tools/bench_diff.py)
+    ls = get_ledger().summary()
+    log(
+        f"[bench] cost ledger: {ls['executables']} executables, "
+        f"{ls['compile_s_total']}s total compile, cache hit ratio "
+        f"{ls['cache_hit_ratio']}"
+    )
     if real_botnet:
         record["real_botnet"] = real_botnet
     if serving:

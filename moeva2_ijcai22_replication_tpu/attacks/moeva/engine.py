@@ -33,6 +33,7 @@ from ...core.norms import is_l2, lp_distance, validate_norm
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
 from ...observability import device_memory_stats
+from ...observability.ledger import LedgeredJit, get_ledger
 from .initialisation import lp_ratio_init, tile_init
 from .operators import OperatorTables, make_operator_tables, make_offspring
 from .refdirs import energy_ref_dirs, rnsga3_geometry
@@ -244,6 +245,61 @@ class Moeva2:
         #: number of program (re)traces across init + segment — one per
         #: distinct executable (grid observability reads the delta per point).
         self.trace_count = 0
+        #: (entry, compile_s) per ledger dispatch of the current ``generate``
+        #: — drained by :meth:`_attribute_run` into roofline run seconds.
+        self._dispatch_log: list = []
+        #: ledger keys (and per-key dispatch counts) the most recent
+        #: ``generate`` dispatched — serving joins them with its
+        #: device_run span for per-span roofline attribution.
+        self.last_run_executables: list[str] = []
+        self.last_run_dispatch_counts: dict[str, int] = {}
+
+    def _ledger_identity(self) -> dict:
+        """Compile-time identity of this engine's executables for the cost
+        ledger (mirrors the engine-cache key, human-readable)."""
+        from ..sharding import describe_mesh
+
+        return {
+            "engine": "moeva2",
+            "cache_key": getattr(self, "cache_key", None),
+            "norm": str(self.norm),
+            "n_pop": self.n_pop,
+            "pop_size": self.pop_size,
+            "n_offsprings": self.n_offsprings,
+            "archive_size": self.archive_size,
+            "save_history": self.save_history,
+            "mesh": describe_mesh(self.mesh),
+        }
+
+    def _on_ledger_dispatch(self, entry, compile_s: float) -> None:
+        self._dispatch_log.append((entry, compile_s))
+
+    def _attribute_run(self, elapsed: float) -> None:
+        """Split one ``generate``'s measured wall-clock (compile excluded)
+        across the executables it dispatched, weighted by the cost model
+        (per-dispatch FLOPs; uniform when no backend cost model) — the
+        engine's dispatches are chained asynchronously, so per-executable
+        timing exists only at this aggregate level (documented as
+        approximate in DESIGN § cost ledger)."""
+        log, self._dispatch_log = self._dispatch_log, []
+        entries = [e for e, _ in log if e is not None]
+        self.last_run_executables = list(
+            dict.fromkeys(e.key for e in entries)
+        )
+        counts: dict[str, int] = {}
+        for e in entries:
+            counts[e.key] = counts.get(e.key, 0) + 1
+        self.last_run_dispatch_counts = counts
+        if not entries:
+            return
+        run_total = max(elapsed - sum(c for _, c in log), 0.0)
+        weights = [e.flops for e in entries]
+        if not all(weights):
+            weights = [1.0] * len(entries)
+        total_w = sum(weights)
+        ledger = get_ledger()
+        for e, w in zip(entries, weights):
+            ledger.add_run_seconds(e.key, run_total * w / total_w)
 
     # -- objective kernel ---------------------------------------------------
     def _evaluate(self, params, x_gen, x_init_ml, x_init_mm, xl_ml, xu_ml, minimize_class):
@@ -470,12 +526,19 @@ class Moeva2:
             raise ValueError("minimize_class must be scalar or length n_states")
 
         chunk = self.effective_states_chunk()
-        if chunk and s > chunk:
-            return self._generate_chunked(x, minimize_class, chunk)
-        return self._generate_one(
-            x, minimize_class,
-            jax.random.PRNGKey(self.seed), self.checkpoint_path,
-        )
+        self._dispatch_log = []
+        t0 = time.perf_counter()
+        try:
+            if chunk and s > chunk:
+                return self._generate_chunked(x, minimize_class, chunk)
+            return self._generate_one(
+                x, minimize_class,
+                jax.random.PRNGKey(self.seed), self.checkpoint_path,
+            )
+        finally:
+            # roofline attribution at the one point where every dispatched
+            # segment has been fetched (the result decode above synced)
+            self._attribute_run(time.perf_counter() - t0)
 
     def _generate_chunked(self, x, minimize_class, chunk) -> MoevaResult:
         """Sequential chunks of one compiled program; the tail chunk is
@@ -606,7 +669,15 @@ class Moeva2:
                 ok = (f[..., 0] < thr) & (f[..., 2] <= 0.0) & (f[..., 1] <= eps)
                 return ok.any(axis=1)
 
-            self._jit_success = jax.jit(success_mask)
+            self._jit_success = LedgeredJit(
+                jax.jit(success_mask),
+                producer="moeva_success",
+                identity=self._ledger_identity,
+                describe_args=lambda pop_f, *rest: {
+                    "rows": int(pop_f.shape[0])
+                },
+                on_dispatch=self._on_ledger_dispatch,
+            )
         # early_stop_eps is a distance in normalised feature space; the
         # carried f2 objective divides L2 distances by sqrt(D)
         eps = float(self.early_stop_eps) / self._f2_scale
@@ -725,17 +796,38 @@ class Moeva2:
         xu_ml = np.broadcast_to(np.asarray(xu_ml, dtype=np.float64), x.shape)
 
         if self._jit_init is None:
-            self._jit_init = jax.jit(self._build_init())
+            # LedgeredJit = AOT compile + dispatch of the exact executable
+            # the jit cache would build, with the cost ledger observing
+            # every compile (identity, cost/memory analysis, wall-clock)
+            self._jit_init = LedgeredJit(
+                jax.jit(self._build_init()),
+                producer="moeva_init",
+                identity=self._ledger_identity,
+                describe_args=lambda params, x_init_ml, *rest: {
+                    "rows": int(x_init_ml.shape[0])
+                },
+                on_dispatch=self._on_ledger_dispatch,
+            )
             # Donate the evolution carry: without donation every chained
             # segment holds TWO full population copies in HBM (the consumed
             # input and the produced output); with it XLA reuses the buffers
             # in place. Host code never touches a carry after re-dispatching
             # it (checkpoint saves and mask fetches read the *output* carry
             # before the next dispatch consumes it).
-            self._jit_segment = jax.jit(
-                self._build_segment(),
-                static_argnames="length",
-                donate_argnums=(5,),
+            self._jit_segment = LedgeredJit(
+                jax.jit(
+                    self._build_segment(),
+                    static_argnames="length",
+                    donate_argnums=(5,),
+                ),
+                producer="moeva_segment",
+                identity=self._ledger_identity,
+                describe_args=lambda params, x_init_ml, *rest, **kw: {
+                    "rows": int(x_init_ml.shape[0]),
+                    "length": int(kw.get("length", 0)),
+                },
+                static_argnames=("length",),
+                on_dispatch=self._on_ledger_dispatch,
             )
 
         args = (

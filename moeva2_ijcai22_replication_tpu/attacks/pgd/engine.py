@@ -22,6 +22,7 @@ constraint loss unscales in-graph (``pgd/classifier.py:82-105``).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -33,6 +34,7 @@ from ...core.constraints import ConstraintSet
 from ...core.norms import condition_grad, is_inf, project_ball
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
+from ...observability.ledger import LedgeredJit, get_ledger
 
 
 @dataclass
@@ -81,6 +83,27 @@ class ConstrainedPGD:
         #: distinct executable. ε/ε-step are runtime arguments, so an ε sweep
         #: over a cached engine keeps this at 1 (grid observability reads it).
         self.trace_count = 0
+        #: ledger keys (and per-key dispatch counts) of the executables the
+        #: most recent ``generate`` dispatched — serving joins them with
+        #: its device_run span for per-span roofline attribution
+        self.last_run_executables: list[str] = []
+        self.last_run_dispatch_counts: dict[str, int] = {}
+
+    def _ledger_identity(self) -> dict:
+        """Compile-time identity of this engine's executables for the cost
+        ledger: everything the engine-cache key encodes, human-readable."""
+        from ..sharding import describe_mesh
+
+        return {
+            "engine": type(self).__name__,
+            "cache_key": getattr(self, "cache_key", None),
+            "loss_evaluation": self.loss_evaluation,
+            "constraints_optim": self.constraints_optim,
+            "norm": str(self.norm),
+            "num_random_init": self.num_random_init,
+            "record_loss": self.record_loss,
+            "mesh": describe_mesh(self.mesh),
+        }
 
     # -- loss ---------------------------------------------------------------
     def _loss_weights(self, i, dtype, max_iter):
@@ -334,10 +357,22 @@ class ConstrainedPGD:
             )
         if self._jit_attack is None:
             # the baked-budget programs take max_iter as a static arg so the
-            # jitted callable's signature stays uniform across both modes
-            self._jit_attack = jax.jit(
-                self._build(),
-                static_argnums=() if runtime_iters else (6,),
+            # jitted callable's signature stays uniform across both modes.
+            # LedgeredJit compiles AOT and dispatches the same executable the
+            # jit cache would have — the cost ledger observes every compile
+            # (identity, cost/memory analysis, wall-clock) as it happens.
+            static = () if runtime_iters else (6,)
+            self._jit_attack = LedgeredJit(
+                jax.jit(self._build(), static_argnums=static),
+                producer="pgd_attack",
+                identity=self._ledger_identity,
+                describe_args=lambda params, x, *rest: {
+                    "rows": int(x.shape[0]),
+                    "max_iter": None
+                    if runtime_iters
+                    else (int(rest[-1]) if rest else self.max_iter),
+                },
+                static_argnums=static,
             )
         mi = (
             jnp.asarray(max_iter, jnp.int32)
@@ -366,6 +401,7 @@ class ConstrainedPGD:
             if runtime_iters:
                 mi = repl_out[4]
             args = (params, x_dev, y_dev, key, eps_d, step_d)
+        t0 = time.perf_counter()
         out, hist = self._jit_attack(*args, mi)
         # (N, max_iter, C) — runners add the reference's unit axis on save
         # (01_pgd_united.py:196-199).
@@ -374,7 +410,21 @@ class ConstrainedPGD:
             if self.record_loss
             else None
         )
-        return np.asarray(jax.device_get(out))
+        x_out = np.asarray(jax.device_get(out))
+        # roofline attribution: this fetch is the dispatch's sync point, so
+        # dispatch->fetched wall-clock (compile excluded) is the run time of
+        # exactly one executable
+        entry = self._jit_attack.last_entry
+        self.last_run_executables = [entry.key] if entry is not None else []
+        self.last_run_dispatch_counts = (
+            {entry.key: 1} if entry is not None else {}
+        )
+        if entry is not None:
+            get_ledger().add_run_seconds(
+                entry.key,
+                time.perf_counter() - t0 - self._jit_attack.last_call_compile_s,
+            )
+        return x_out
 
 
 def round_ints_toward_initial(

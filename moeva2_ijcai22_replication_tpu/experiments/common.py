@@ -43,6 +43,9 @@ class ArtifactCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        #: stamp-invalidated rebuilds (a file changed on disk under a live
+        #: key) — the cache's only eviction mode, counted for /healthz
+        self.evictions = 0
 
     @staticmethod
     def _stamp(paths: tuple) -> tuple:
@@ -62,13 +65,20 @@ class ArtifactCache:
             if entry is not None and entry[0] == stamp:
                 self.hits += 1
                 return entry[1]
+            if entry is not None:
+                self.evictions += 1
             self.misses += 1
             value = builder()
             self._entries[key] = (stamp, value)
             return value
 
     def stats(self) -> dict:
-        return {"hits": self.hits, "misses": self.misses, "entries": len(self._entries)}
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "entries": len(self._entries),
+        }
 
     def clear(self):
         self._entries.clear()
@@ -91,13 +101,21 @@ class EngineCache:
     ``n_gen``) are reassigned on the cached instance per point.
     """
 
+    #: recompile causes kept (bounded; the key space is client-controlled)
+    MAX_CAUSES = 32
+
     def __init__(self):
         self._engines: dict = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        #: structured "why did this miss build a new engine" records:
+        #: which key fields differed from the nearest existing entry
+        self.recompile_causes: list[dict] = []
 
-    def get(self, key: tuple, builder):
+    def get(self, key: tuple, builder, fields: tuple | None = None):
+        """``fields`` optionally names the key's positions so a miss can be
+        explained field-by-field (the /healthz recompile-cause view)."""
         # serialized like ArtifactCache.get: a racing miss must not build
         # two engine instances for one key (each would trace its own
         # executables — exactly the duplication this cache exists to prevent)
@@ -107,9 +125,49 @@ class EngineCache:
                 self.hits += 1
                 return engine
             self.misses += 1
+            cause = self._recompile_cause(key, fields)
+            if cause is not None:
+                self.recompile_causes.append(cause)
+                del self.recompile_causes[: -self.MAX_CAUSES]
             engine = builder()
+            # stable per-process identity for the cost ledger: entries
+            # compiled by this engine carry it, joining executables back
+            # to their cache slot (best-effort — not every cached value
+            # accepts attributes)
+            try:
+                engine.cache_key = f"{key[0]}:{get_dict_hash(repr(key))[:12]}"
+            except AttributeError:
+                pass
             self._engines[key] = engine
             return engine
+
+    def _recompile_cause(self, key: tuple, fields: tuple | None) -> dict | None:
+        """Diff the missing key against the nearest cached key of the same
+        family and name the fields that differed — "budget 100 -> 1000"
+        explains a rebuild faster than two opaque tuples. None on a cold
+        miss (nothing comparable cached). The nearest-diff algorithm is
+        shared with the executable ledger's recompile causes."""
+        from ..observability.ledger import nearest_identity_diff
+
+        names = list(fields or ())
+
+        def as_identity(k: tuple) -> dict:
+            return {
+                (names[i] if i < len(names) else f"field_{i}"): repr(k[i])
+                for i in range(len(k))
+            }
+
+        cause = nearest_identity_diff(
+            (
+                (None, as_identity(k))
+                for k in self._engines
+                if k[0] == key[0] and len(k) == len(key)
+            ),
+            as_identity(key),
+        )
+        if cause is None:
+            return None
+        return {"family": str(key[0]), "changed": cause["changed"]}
 
     def stats(self) -> dict:
         return {
@@ -134,7 +192,13 @@ def setup_jax_cache(config: dict | None = None) -> None:
     every runner invocation of the same jitted attack program after the first
     loads its executable from disk instead of recompiling (~tens of seconds
     per program shape; an rq grid revisits the same handful of shapes across
-    many processes). ``system.jax_cache_dir: ""`` disables."""
+    many processes). ``system.jax_cache_dir: ""`` disables.
+
+    Also applies ``system.cost_ledger`` (default on): this is the one
+    process-level setup hook every runner and bench path already calls."""
+    from ..observability.ledger import configure_ledger
+
+    configure_ledger(config)
     import jax
 
     cache_dir = ".jax_cache"

@@ -30,7 +30,7 @@ from ..attacks.moeva import Moeva2
 from ..attacks.objective import ObjectiveCalculator
 from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
-from ..observability import Trace, recorder_for, telemetry_block
+from ..observability import Trace, get_ledger, recorder_for, telemetry_block
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file, save_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
@@ -44,6 +44,13 @@ def _cached_engine(config, surrogate, constraints, scaler):
     (the per-segment scan length is a jit static argument), so they are
     per-point attributes, not key material."""
     mesh_devices = int(config.get("system", {}).get("mesh_devices", 0) or 0)
+    # field names travel with the key so a cache miss can be explained
+    # field-by-field (the recompile-cause view on /healthz)
+    fields = (
+        "engine", "surrogate", "constraints", "scaler", "norm", "n_pop",
+        "n_offsprings", "init", "init_eps", "init_ratio", "archive_size",
+        "assoc_block", "max_states_per_call", "save_history", "mesh_devices",
+    )
     key = (
         "moeva",
         id(surrogate),
@@ -83,7 +90,7 @@ def _cached_engine(config, surrogate, constraints, scaler):
             mesh=common.build_mesh(config),
         )
 
-    return common.ENGINES.get(key, build)
+    return common.ENGINES.get(key, build, fields=fields)
 
 
 def run(config: dict, pipeline=None):
@@ -113,6 +120,9 @@ def run(config: dict, pipeline=None):
         else None
     )
     timer = PhaseTimer(trace=trace)
+    # cost-ledger window: the metrics' telemetry.cost reports THIS run's
+    # executables/compiles, not the process lifetime (shared-engine grids)
+    ledger_mark = get_ledger().mark()
 
     # ----- Load and create necessary objects (04_moeva.py:41-60)
     with timer.phase("setup"):
@@ -255,6 +265,7 @@ def run(config: dict, pipeline=None):
                 device=moeva.mesh.devices.flat[0]
                 if moeva.mesh is not None
                 else None,
+                ledger_since=ledger_mark,
             ),
             "config": config,
             "config_hash": config_hash,

@@ -29,7 +29,7 @@ from ..attacks.pgd import AutoPGD, ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sat import SatAttack
 from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
-from ..observability import Trace, recorder_for, telemetry_block
+from ..observability import Trace, get_ledger, recorder_for, telemetry_block
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
@@ -50,6 +50,13 @@ def _cached_attack(config, surrogate, constraints, scaler):
     # AutoPGD / history programs bake the budget (see _runtime_max_iter):
     # those get one engine per budget; plain PGD shares across budgets
     budget_is_static = cls is AutoPGD or bool(record_loss)
+    # field names travel with the key so a cache miss can be explained
+    # field-by-field (the recompile-cause view on /healthz)
+    fields = (
+        "engine", "surrogate", "constraints", "scaler", "budget", "norm",
+        "loss_evaluation", "constraints_optim", "num_random_init",
+        "record_loss", "record_grad_norm", "mesh_devices",
+    )
     key = (
         cls.__name__,
         id(surrogate),
@@ -80,7 +87,7 @@ def _cached_attack(config, surrogate, constraints, scaler):
             mesh=common.build_mesh(config),
         )
 
-    return common.ENGINES.get(key, build)
+    return common.ENGINES.get(key, build, fields=fields)
 
 
 def run(config: dict, pipeline=None):
@@ -108,6 +115,9 @@ def run(config: dict, pipeline=None):
         else None
     )
     timer = PhaseTimer(trace=trace)
+    # cost-ledger window: the metrics' telemetry.cost reports THIS run's
+    # executables/compiles, not the process lifetime (shared-engine grids)
+    ledger_mark = get_ledger().mark()
     apply_sat = "sat" in config["loss_evaluation"]
 
     with timer.phase("setup"):
@@ -253,6 +263,7 @@ def run(config: dict, pipeline=None):
                 device=attack.mesh.devices.flat[0]
                 if attack.mesh is not None
                 else None,
+                ledger_since=ledger_mark,
             ),
             "config": config,
             "config_hash": config_hash,

@@ -38,7 +38,12 @@ import queue
 import threading
 import time
 
-from ..observability import TraceRecorder, telemetry_block, validate_record
+from ..observability import (
+    TraceRecorder,
+    get_ledger,
+    telemetry_block,
+    validate_record,
+)
 from ..utils.config import get_dict_hash
 from . import common
 
@@ -67,6 +72,10 @@ class GridPipeline:
         self._t0 = time.perf_counter()  # monotonic: NTP-step-proof wallclock
         self._artifacts0 = common.ARTIFACTS.stats()
         self._engines0 = common.ENGINES.stats()
+        # cost-ledger snapshots: the report scopes the process ledger to
+        # this sweep (executables/compile-seconds added BY the grid)
+        self._ledger0 = get_ledger().summary()
+        self._ledger_mark = get_ledger().mark()
 
     # -- background writer ---------------------------------------------------
     def _worker(self):
@@ -174,6 +183,9 @@ class GridPipeline:
                 common.ARTIFACTS.stats(), self._artifacts0
             ),
             "engine_cache": self._delta(common.ENGINES.stats(), self._engines0),
+            # this grid's executable-cost footprint (satellite of the cost
+            # ledger: report next to the cache deltas it explains)
+            "ledger": get_ledger().summary_delta(self._ledger0),
             "writer": {
                 "submitted": self._submitted,
                 "failures": self.write_failures,
@@ -187,7 +199,9 @@ class GridPipeline:
                     or 0
                 ),
             },
-            "telemetry": telemetry_block(recorder=self.recorder),
+            "telemetry": telemetry_block(
+                recorder=self.recorder, ledger_since=self._ledger_mark
+            ),
             "points": points,
         }
         validate_record(report, "grid")

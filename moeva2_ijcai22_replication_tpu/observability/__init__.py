@@ -11,7 +11,11 @@ and Prometheus text exposition (``observability.prom`` behind
 Contract: cheap counters/gauges are always on; spans/events are opt-in
 (``TraceRecorder.spans_enabled``), and with them off every instrumented
 path is a no-op — zero extra device dispatches, zero extra compiles
-(pinned by the tier-1 overhead smoke). ``PhaseTimer`` and
+(pinned by the tier-1 overhead smoke). The executable cost ledger
+(``observability.ledger``) rides the same contract from the other side:
+it observes every compile (identity, XLA cost/memory analysis, wall-clock)
+at the AOT capture point the engines already dispatch through, so
+ledger-on and ledger-off runs are bit-identical. ``PhaseTimer`` and
 ``ServiceMetrics`` (``utils/observability.py``) are thin facades over this
 recorder, so grid reports, bench records, and serving metadata share one
 event stream; ``records.telemetry_block`` / ``records.validate_record``
@@ -19,6 +23,15 @@ keep every committed record carrying the shared ``execution`` +
 ``telemetry`` schema.
 """
 
+from .ledger import (
+    LEDGER,
+    CostLedger,
+    LedgeredJit,
+    LedgerEntry,
+    configure_ledger,
+    get_ledger,
+    ledger_context,
+)
 from .records import (
     REQUIRED_RECORD_KEYS,
     build_identity,
@@ -37,13 +50,20 @@ from .trace import (
 )
 
 __all__ = [
+    "LEDGER",
     "REQUIRED_RECORD_KEYS",
+    "CostLedger",
+    "LedgerEntry",
+    "LedgeredJit",
     "Trace",
     "TraceRecorder",
     "build_identity",
+    "configure_ledger",
     "current_trace",
     "default_recorder",
     "device_memory_stats",
+    "get_ledger",
+    "ledger_context",
     "maybe_span",
     "recorder_for",
     "telemetry_block",

@@ -13,17 +13,38 @@ wrapper.
 from __future__ import annotations
 
 import json
+import warnings
 from typing import Iterable
 
 
-def read_jsonl(path: str) -> list[dict]:
-    """Load a ``system.trace_log`` JSONL sink (blank lines skipped)."""
+def read_jsonl(path: str, strict: bool = False) -> list[dict]:
+    """Load a ``system.trace_log`` JSONL sink (blank lines skipped).
+
+    A live or crashed process leaves the sink with a partial last line
+    (the write was cut mid-event); an idle one may leave it empty. Both
+    are normal states for an append-only log, so unparseable lines are
+    skipped with a warning — an empty event list (rendering to an empty
+    Perfetto document) beats a stack trace from ``json.loads``. Pass
+    ``strict=True`` to re-raise instead (debugging a corrupt sink)."""
     events = []
+    skipped = 0
     with open(path) as fh:
         for line in fh:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 events.append(json.loads(line))
+            except json.JSONDecodeError:
+                if strict:
+                    raise
+                skipped += 1
+    if skipped:
+        warnings.warn(
+            f"{path}: skipped {skipped} unparseable JSONL line(s) — "
+            "truncated write from a live or crashed recorder?",
+            stacklevel=2,
+        )
     return events
 
 

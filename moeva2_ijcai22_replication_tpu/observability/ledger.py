@@ -1,0 +1,678 @@
+"""Executable cost ledger: what every compiled program *costs*, not just
+how long it ran.
+
+PR 4's spans say where wall-clock went; this module records what the
+hardware was asked to do. A process-wide :class:`CostLedger` captures, at
+the moment each executable is built, its identity (producer, engine cache
+key, batch rows, loss strategy, mesh, static knobs), XLA's cost model
+(``compiled.cost_analysis()`` FLOPs / bytes accessed), its memory
+footprint (``compiled.memory_analysis()`` argument/output/temp/code
+bytes), and the compile wall-clock. Joining those static costs with the
+measured run seconds (attributed by the engines at their existing sync
+points) yields roofline-style attribution: achieved FLOP/s, achieved
+bytes/s, and arithmetic intensity per executable.
+
+The capture point is :class:`LedgeredJit`, an AOT compile-and-dispatch
+wrapper around a ``jax.jit`` callable: it lowers and compiles explicitly
+(``jitted.lower(*args).compile()``) exactly when the implicit jit cache
+would have, caches the compiled executable under the argument avals, and
+dispatches through it. Same lowering, same executable, one device
+execution per call — the ledger only *observes*; ``system.cost_ledger:
+false`` turns the bookkeeping off without touching the dispatch path, so
+ledger-on and ledger-off runs are bit-identical by construction. (Going
+through the jit cache and *separately* AOT-compiling would double every
+compile: on jax 0.4.x the AOT and jit executable caches are disjoint.)
+
+Graceful degradation: some jax versions/backends return ``None`` from —
+or raise inside — ``cost_analysis()`` / ``memory_analysis()``; the probes
+below swallow that and the entry records ``cost_available: false``. If
+AOT lowering itself fails, the wrapper falls back to the plain jitted
+call and records a degraded (``aot: false``) entry. Observability must
+never take an attack down.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+#: identity attrs pushed by an enclosing dispatch site (e.g. the serving
+#: microbatcher's bucket) into every entry compiled under it.
+_context: contextvars.ContextVar = contextvars.ContextVar(
+    "moeva2_ledger_context", default=None
+)
+
+
+@contextlib.contextmanager
+def ledger_context(**attrs):
+    """Merge ``attrs`` into the identity of every executable compiled in
+    this dynamic extent (the microbatcher wraps each batch dispatch so the
+    bucket size and batch composition land in the ledger)."""
+    token = _context.set(dict(_context.get() or {}, **attrs))
+    try:
+        yield
+    finally:
+        _context.reset(token)
+
+
+# -- cost-model probes --------------------------------------------------------
+def probe_cost_analysis(compiled) -> dict | None:
+    """Best-effort ``{flops, bytes_accessed, transcendentals}`` from
+    ``compiled.cost_analysis()``. None when the backend ships no cost
+    model (the call raises, returns None, or returns an empty mapping) —
+    jax returns a per-device list on some versions, a bare dict on others,
+    and raises ``Unimplemented`` on some backends."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    out = {}
+    for src, dst in (
+        ("flops", "flops"),
+        ("bytes accessed", "bytes_accessed"),
+        ("transcendentals", "transcendentals"),
+    ):
+        v = ca.get(src)
+        if v is not None:
+            try:
+                out[dst] = float(v)
+            except (TypeError, ValueError):
+                continue
+    return out or None
+
+
+def probe_memory_analysis(compiled) -> dict | None:
+    """Best-effort byte footprint from ``compiled.memory_analysis()``:
+    argument/output/temp/alias/generated-code sizes. None when the backend
+    does not implement it (raises or returns None)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr, dst in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "code_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            try:
+                out[dst] = int(v)
+            except (TypeError, ValueError):
+                continue
+    return out or None
+
+
+def nearest_identity_diff(candidates, identity: dict) -> dict | None:
+    """Why did a cache miss happen given what's already cached? Diff
+    ``identity`` against the nearest of ``candidates`` (an iterable of
+    ``(ref, identity_dict)``, nearest = fewest differing fields) and name
+    exactly the fields that differed — "rows 64 -> 128" reads a lot
+    faster than two opaque keys. None when nothing is comparable (a cold
+    miss, not a *re*compile). Shared by the executable ledger and the
+    engine cache so the /healthz recompile-cause views stay one schema."""
+    best = None
+    for ref, ident in candidates:
+        fields = sorted(set(identity) | set(ident))
+        diffs = [f for f in fields if identity.get(f) != ident.get(f)]
+        if best is None or len(diffs) < len(best[2]):
+            best = (ref, ident, diffs)
+    if best is None:
+        return None
+    ref, ident, diffs = best
+    return {
+        "nearest": ref,
+        "changed": {
+            f: {"from": ident.get(f), "to": identity.get(f)} for f in diffs
+        },
+    }
+
+
+# -- entries ------------------------------------------------------------------
+@dataclass
+class LedgerEntry:
+    """One compiled executable: identity + static cost + measured use."""
+
+    key: str  #: stable id: ``{producer}#{seq}``
+    producer: str  #: which program family built it (pgd_attack, moeva_segment…)
+    identity: dict  #: JSON-ready compile-time identity (cache key, rows, knobs)
+    backend: str
+    compile_s: float
+    cost_available: bool  #: cost OR memory model present (satellite contract)
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    transcendentals: float | None = None
+    memory: dict | None = None
+    aot: bool = True  #: False = jit fallback (lowering failed); no cost model
+    dispatches: int = 0
+    run_s: float = 0.0  #: attributed device+fetch seconds (engines' sync points)
+    created_wall: float = field(default_factory=time.time)
+
+    def roofline(self, dispatches: int | None = None, run_s: float | None = None) -> dict:
+        """Achieved rates from the cost model joined with attributed run
+        seconds. ``arithmetic_intensity`` is the static model ratio
+        (FLOPs per HBM byte — where the program sits on the roofline);
+        achieved rates need at least one attributed dispatch. Pass
+        ``dispatches``/``run_s`` to compute over a window instead of the
+        entry lifetime (the per-record cost blocks)."""
+        d = self.dispatches if dispatches is None else dispatches
+        r = self.run_s if run_s is None else run_s
+        out: dict = {
+            "dispatches": d,
+            "run_s": round(r, 6),
+            "achieved_flops_s": None,
+            "achieved_bytes_s": None,
+            "arithmetic_intensity": None,
+        }
+        if self.flops is not None and self.bytes_accessed:
+            out["arithmetic_intensity"] = round(
+                self.flops / self.bytes_accessed, 4
+            )
+        if r > 0:
+            if self.flops is not None:
+                out["achieved_flops_s"] = round(self.flops * d / r, 1)
+            if self.bytes_accessed is not None:
+                out["achieved_bytes_s"] = round(
+                    self.bytes_accessed * d / r, 1
+                )
+        return out
+
+    def as_dict(
+        self,
+        compile_s: float | None = None,
+        dispatches: int | None = None,
+        run_s: float | None = None,
+    ) -> dict:
+        return {
+            "key": self.key,
+            "producer": self.producer,
+            "identity": self.identity,
+            "backend": self.backend,
+            "compile_s": round(
+                self.compile_s if compile_s is None else compile_s, 4
+            ),
+            "cost_available": self.cost_available,
+            "aot": self.aot,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "transcendentals": self.transcendentals,
+            "memory": self.memory,
+            **self.roofline(dispatches, run_s),
+        }
+
+
+class CostLedger:
+    """Process-wide registry of compiled executables and their costs."""
+
+    #: recompile causes kept (bounded — the ledger must not grow with
+    #: serving uptime)
+    MAX_CAUSES = 64
+
+    def __init__(self, enabled: bool = True):
+        self._lock = threading.Lock()
+        self._entries: dict[str, LedgerEntry] = {}
+        self._seq = 0
+        self.enabled = enabled
+        self.hits = 0  #: executable-cache hits (dispatches that reused)
+        self.misses = 0  #: compiles (AOT or fallback)
+        self.recompile_causes: list[dict] = []
+
+    # -- recording -----------------------------------------------------------
+    def record_compile(
+        self,
+        *,
+        producer: str,
+        identity: dict,
+        backend: str,
+        compile_s: float,
+        cost: dict | None,
+        memory: dict | None,
+        aot: bool = True,
+    ) -> LedgerEntry | None:
+        """Register a freshly compiled executable; returns its entry (None
+        when the ledger is disabled — the compile itself already happened
+        identically either way)."""
+        with self._lock:
+            self.misses += 1
+            if not self.enabled:
+                return None
+            self._seq += 1
+            key = f"{producer}#{self._seq}"
+            cause = self._recompile_cause_locked(producer, identity, key)
+            entry = LedgerEntry(
+                key=key,
+                producer=producer,
+                identity=dict(identity),
+                backend=backend,
+                compile_s=float(compile_s),
+                cost_available=bool(cost or memory),
+                flops=(cost or {}).get("flops"),
+                bytes_accessed=(cost or {}).get("bytes_accessed"),
+                transcendentals=(cost or {}).get("transcendentals"),
+                memory=memory,
+                aot=aot,
+            )
+            self._entries[key] = entry
+            if cause is not None:
+                self.recompile_causes.append(cause)
+                del self.recompile_causes[: -self.MAX_CAUSES]
+            return entry
+
+    def _recompile_cause_locked(
+        self, producer: str, identity: dict, key: str
+    ) -> dict | None:
+        cause = nearest_identity_diff(
+            (
+                (e.key, e.identity)
+                for e in self._entries.values()
+                if e.producer == producer
+            ),
+            identity,
+        )
+        if cause is None:
+            return None
+        return {"key": key, "producer": producer, **cause}
+
+    def record_hit(self) -> None:
+        with self._lock:
+            self.hits += 1
+
+    def record_dispatch(self, key: str) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.dispatches += 1
+
+    def add_compile_seconds(self, key: str, seconds: float) -> None:
+        """Late compile attribution: the AOT-fallback path pays its real
+        trace + XLA compile inside the first jit dispatch, after the entry
+        was recorded."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.compile_s += float(seconds)
+
+    def add_run_seconds(self, key: str, seconds: float) -> None:
+        """Attribute measured run wall-clock (dispatch to fetched result,
+        compile excluded) to an executable — called by the engines at
+        their existing device→host sync points, never by adding one."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.run_s += float(seconds)
+
+    # -- introspection -------------------------------------------------------
+    def entries(self) -> list[LedgerEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def summary(self) -> dict:
+        """The health-endpoint view: executable count, total compile
+        seconds, executable-cache hit ratio."""
+        with self._lock:
+            entries = list(self._entries.values())
+            hits, misses = self.hits, self.misses
+        total = hits + misses
+        flops = [
+            e.flops * e.dispatches
+            for e in entries
+            if e.flops is not None and e.dispatches
+        ]
+        return {
+            "enabled": self.enabled,
+            "executables": len(entries),
+            "compile_s_total": round(sum(e.compile_s for e in entries), 3),
+            "dispatches": sum(e.dispatches for e in entries),
+            # dispatch-weighted model FLOPs — the work normalizer
+            # tools/bench_diff.py divides wall-clock by
+            "flops_total": sum(flops) if flops else None,
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "cache_hit_ratio": round(hits / total, 4) if total else None,
+            "cost_available": any(e.cost_available for e in entries),
+        }
+
+    def summary_delta(self, before: dict) -> dict:
+        """``summary()`` relative to an earlier snapshot (numeric keys
+        subtract; the hit ratio is recomputed over the window) — how a
+        grid report scopes the process ledger to one sweep."""
+        now = self.summary()
+        out = {
+            k: now[k] - before.get(k, 0)
+            for k in (
+                "executables",
+                "compile_s_total",
+                "dispatches",
+                "cache_hits",
+                "cache_misses",
+            )
+        }
+        out["compile_s_total"] = round(out["compile_s_total"], 3)
+        window = out["cache_hits"] + out["cache_misses"]
+        out["cache_hit_ratio"] = (
+            round(out["cache_hits"] / window, 4) if window else None
+        )
+        return out
+
+    def mark(self) -> dict:
+        """Opaque snapshot for window-scoped cost blocks
+        (``cost_block(since=mark)``): record producers take one at run
+        start so ``telemetry.cost`` reports the executables *this run*
+        compiled and dispatched — not the process lifetime, which on a
+        shared-engine grid would charge every warm point with the first
+        point's compile and corrupt bench_diff's work normalizer. Under
+        the grid pipeline's host/device overlap a neighbouring point's
+        dispatches can bleed into the window; scoping is per-window, not
+        per-thread."""
+        with self._lock:
+            return {
+                "entries": {
+                    k: (e.dispatches, e.run_s)
+                    for k, e in self._entries.items()
+                },
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+    def cost_block(self, since: dict | None = None) -> dict:
+        """The ``telemetry.cost`` sub-block every bench/grid/serving
+        record carries: a summary plus per-executable identity, cost, and
+        roofline rows (JSON-ready; bounded by the number of compiled
+        programs, which the bucket-menu discipline keeps small). With
+        ``since`` (a :meth:`mark`), entries and totals are scoped to the
+        window: executables compiled in it carry their compile time,
+        pre-existing executables appear only if re-dispatched (compile
+        charged as 0 — it happened before this run), and dispatch/run
+        numbers are deltas."""
+        with self._lock:
+            entries = list(self._entries.values())
+            hits, misses = self.hits, self.misses
+        prev = (since or {}).get("entries", {})
+        rows: list[tuple[LedgerEntry, float, int, float]] = []
+        for e in entries:
+            p = prev.get(e.key)
+            if p is None:
+                rows.append((e, e.compile_s, e.dispatches, e.run_s))
+            elif e.dispatches > p[0]:
+                rows.append(
+                    (e, 0.0, e.dispatches - p[0], max(e.run_s - p[1], 0.0))
+                )
+        if since is not None:
+            hits -= since.get("hits", 0)
+            misses -= since.get("misses", 0)
+        total = hits + misses
+        flops = [
+            e.flops * d for (e, _, d, _) in rows
+            if e.flops is not None and d
+        ]
+        return {
+            "enabled": self.enabled,
+            "executables": len(rows),
+            "compile_s_total": round(sum(c for (_, c, _, _) in rows), 3),
+            "dispatches": sum(d for (_, _, d, _) in rows),
+            "flops_total": sum(flops) if flops else None,
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "cache_hit_ratio": round(hits / total, 4) if total else None,
+            "cost_available": any(e.cost_available for (e, _, _, _) in rows),
+            "entries": [
+                e.as_dict(compile_s=c, dispatches=d, run_s=r)
+                for (e, c, d, r) in rows
+            ],
+        }
+
+    def roofline_for(self, executables, seconds: float) -> dict | None:
+        """Static cost of a dispatch set joined with a caller-measured
+        duration (a PR-4 ``device_run`` span): the per-span roofline
+        attrs serving attaches to ``meta.trace``. ``executables`` is
+        either an iterable of keys (one dispatch each) or a
+        ``{key: dispatch_count}`` mapping — a MoEvA span chains the same
+        segment executable many times."""
+        items = (
+            executables.items()
+            if isinstance(executables, dict)
+            else ((k, 1) for k in executables)
+        )
+        flops = 0.0
+        bytes_ = 0.0
+        have = False
+        with self._lock:
+            for k, n in items:
+                e = self._entries.get(k)
+                if e is None:
+                    continue
+                if e.flops is not None:
+                    flops += e.flops * n
+                    have = True
+                if e.bytes_accessed is not None:
+                    bytes_ += e.bytes_accessed * n
+        if not have or seconds <= 0:
+            return None
+        return {
+            "flops": flops,
+            "achieved_flops_s": round(flops / seconds, 1),
+            "achieved_bytes_s": round(bytes_ / seconds, 1) if bytes_ else None,
+        }
+
+    def reset(self) -> None:
+        """Drop all state (tests only — production ledgers live with the
+        process)."""
+        with self._lock:
+            self._entries.clear()
+            self._seq = 0
+            self.hits = self.misses = 0
+            self.recompile_causes = []
+
+
+#: THE process ledger: every producer records here so one /healthz,
+#: /metrics, or telemetry block sees the whole executable population.
+LEDGER = CostLedger()
+
+
+def get_ledger() -> CostLedger:
+    return LEDGER
+
+
+def configure_ledger(config: dict | None) -> CostLedger:
+    """Apply config ``system.cost_ledger`` (default on; the capture is a
+    few dict writes per *compile*, not per dispatch)."""
+    enabled = (config or {}).get("system", {}).get("cost_ledger", True)
+    LEDGER.enabled = bool(enabled)
+    return LEDGER
+
+
+# -- the capture point --------------------------------------------------------
+class LedgeredJit:
+    """AOT compile-and-dispatch wrapper around a ``jax.jit`` callable.
+
+    Caches compiled executables under the dynamic arguments' avals (+
+    shardings + static values) — the same partitioning the jit cache
+    uses for these call sites — and records each compile into the ledger
+    with its identity, cost/memory analysis, and wall-clock. Static
+    arguments (``static_argnums`` positions and all kwargs) are passed to
+    ``lower()`` and dropped from the compiled call, matching jax AOT
+    semantics. ``calls`` counts every dispatch regardless of ledger
+    state (the zero-extra-dispatches contract's witness).
+
+    ``identity`` is a dict or zero-arg callable evaluated at compile
+    time; ``describe_args`` may add per-shape identity (batch rows, scan
+    length) from the actual arguments. ``on_dispatch(entry, compile_s)``
+    fires after every call so the owning engine can attribute run time.
+    """
+
+    def __init__(
+        self,
+        jitted,
+        *,
+        producer: str,
+        identity: dict | Callable[[], dict] | None = None,
+        describe_args: Callable[..., dict] | None = None,
+        static_argnums: tuple = (),
+        static_argnames: tuple = (),
+        on_dispatch: Callable[[Any, float], None] | None = None,
+        ledger: CostLedger | None = None,
+    ):
+        self._jitted = jitted
+        self.producer = producer
+        self._identity = identity
+        self._describe_args = describe_args
+        self._static_argnums = tuple(static_argnums)
+        self._static_argnames = tuple(static_argnames)
+        self._on_dispatch = on_dispatch
+        self._ledger = ledger if ledger is not None else LEDGER
+        self._compiled: dict = {}
+        self._lock = threading.Lock()
+        self.calls = 0  #: total dispatches through this wrapper
+        self.last_entry: LedgerEntry | None = None
+        #: compile seconds consumed by the most recent call (0.0 on an
+        #: executable-cache hit) — callers subtract it from their measured
+        #: wall-clock so run attribution never includes compile time
+        self.last_call_compile_s = 0.0
+
+    # -- keying --------------------------------------------------------------
+    @staticmethod
+    def _leaf_sig(leaf) -> tuple:
+        import numpy as np
+
+        if isinstance(leaf, (bool, int, float, complex)) and not isinstance(
+            leaf, np.generic
+        ):
+            # python scalars trace as weak types; key them apart from
+            # committed arrays of the same dtype
+            return ("py", type(leaf).__name__, ())
+        sharding = getattr(leaf, "sharding", None)
+        return (
+            tuple(np.shape(leaf)),
+            str(getattr(leaf, "dtype", np.asarray(leaf).dtype)),
+            bool(getattr(leaf, "weak_type", False)),
+            str(sharding) if sharding is not None else None,
+        )
+
+    def _split(self, args):
+        dyn, static = [], []
+        for i, a in enumerate(args):
+            (static if i in self._static_argnums else dyn).append(a)
+        return dyn, tuple(static)
+
+    def _key(self, args, kwargs):
+        import jax
+
+        dyn, static = self._split(args)
+        leaves, treedef = jax.tree_util.tree_flatten(dyn)
+        return (
+            static,
+            tuple(sorted(kwargs.items())),
+            treedef,
+            tuple(self._leaf_sig(l) for l in leaves),
+        )
+
+    # -- compile -------------------------------------------------------------
+    def _compile(self, args, kwargs):
+        import jax
+
+        t0 = time.perf_counter()
+        try:
+            compiled = self._jitted.lower(*args, **kwargs).compile()
+        except Exception:
+            # AOT unavailable for this signature: plain jit dispatch —
+            # behavior is preserved, the ledger records the degradation
+            compile_s = time.perf_counter() - t0
+            entry = self._ledger.record_compile(
+                producer=self.producer,
+                identity=self._full_identity(args, kwargs),
+                backend=jax.default_backend(),
+                compile_s=compile_s,
+                cost=None,
+                memory=None,
+                aot=False,
+            )
+            return (None, entry, compile_s)
+        compile_s = time.perf_counter() - t0
+        entry = self._ledger.record_compile(
+            producer=self.producer,
+            identity=self._full_identity(args, kwargs),
+            backend=jax.default_backend(),
+            compile_s=compile_s,
+            cost=probe_cost_analysis(compiled),
+            memory=probe_memory_analysis(compiled),
+        )
+        return (compiled, entry, compile_s)
+
+    def _full_identity(self, args, kwargs) -> dict:
+        ident = self._identity
+        out = dict(ident() if callable(ident) else (ident or {}))
+        if self._describe_args is not None:
+            try:
+                out.update(self._describe_args(*args, **kwargs))
+            except Exception:
+                pass
+        ctx = _context.get()
+        if ctx:
+            out.update(ctx)
+        return out
+
+    # -- dispatch ------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        try:
+            key = self._key(args, kwargs)
+        except Exception:
+            # unkeyable arguments: stay on the jit path, uninstrumented
+            self.last_call_compile_s = 0.0
+            return self._jitted(*args, **kwargs)
+        rec = self._compiled.get(key)
+        if rec is None:
+            with self._lock:
+                rec = self._compiled.get(key)
+                if rec is None:
+                    rec = self._compile(args, kwargs)
+                    self._compiled[key] = rec
+                    compiled_now = True
+                else:
+                    compiled_now = False
+        else:
+            compiled_now = False
+        compiled, entry, compile_s = rec
+        if not compiled_now:
+            self._ledger.record_hit()
+        self.last_call_compile_s = compile_s if compiled_now else 0.0
+        self.last_entry = entry
+        if compiled is None:
+            if compiled_now:
+                # fallback path, first call: the REAL trace + XLA compile
+                # happens synchronously inside this jit call — book it as
+                # compile so the caller's run attribution (elapsed minus
+                # last_call_compile_s) keeps compile out of run seconds
+                t0 = time.perf_counter()
+                out = self._jitted(*args, **kwargs)
+                jit_compile_s = time.perf_counter() - t0
+                self.last_call_compile_s += jit_compile_s
+                if entry is not None:
+                    self._ledger.add_compile_seconds(entry.key, jit_compile_s)
+            else:
+                out = self._jitted(*args, **kwargs)
+        else:
+            dyn, _ = self._split(args)
+            out = compiled(*dyn)
+        if entry is not None:
+            self._ledger.record_dispatch(entry.key)
+        if self._on_dispatch is not None:
+            self._on_dispatch(entry, self.last_call_compile_s)
+        return out

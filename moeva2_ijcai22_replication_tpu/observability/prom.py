@@ -32,9 +32,63 @@ def _fmt(value) -> str:
     return repr(v)
 
 
+def _escape_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _ledger_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Cost-ledger exposition: summary scalars as gauges plus one labeled
+    gauge family per per-executable measure — ``{executable, producer}``
+    labels so a dashboard can plot compile time, FLOPs, and achieved
+    FLOP/s per compiled program."""
+    for key in (
+        "executables",
+        "compile_s_total",
+        "dispatches",
+        "cache_hits",
+        "cache_misses",
+    ):
+        v = block.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            n = _name(prefix, f"cost_ledger_{key}")
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_fmt(v)}")
+    entries = block.get("entries") or []
+    for field in (
+        "compile_s",
+        "flops",
+        "bytes_accessed",
+        "dispatches",
+        "run_s",
+        "achieved_flops_s",
+        "achieved_bytes_s",
+        "arithmetic_intensity",
+    ):
+        rows = [
+            (e, e.get(field))
+            for e in entries
+            if isinstance(e.get(field), (int, float))
+            and not isinstance(e.get(field), bool)
+        ]
+        if not rows:
+            continue
+        n = _name(prefix, f"executable_{field}")
+        lines.append(f"# TYPE {n} gauge")
+        for e, v in rows:
+            labels = (
+                f'executable="{_escape_label(e.get("key"))}",'
+                f'producer="{_escape_label(e.get("producer"))}"'
+            )
+            lines.append(f"{n}{{{labels}}} {_fmt(v)}")
+
+
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     """ServiceMetrics snapshot dict -> Prometheus exposition text."""
     lines: list[str] = []
+
+    ledger_block = snapshot.get("cost_ledger")
+    if isinstance(ledger_block, dict):
+        _ledger_lines(prefix, ledger_block, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
@@ -64,7 +118,7 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     # gauges, one-level dicts of numbers (cache stats) become one gauge per
     # sub-key — so engine/artifact cache health is scrapeable too
     for key, v in sorted(snapshot.items()):
-        if key in ("counters", "gauges", "streams"):
+        if key in ("counters", "gauges", "streams", "cost_ledger"):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             n = _name(prefix, key)

@@ -12,6 +12,7 @@ asserts the producers keep calling it).
 
 from __future__ import annotations
 
+from .ledger import get_ledger
 from .trace import Trace, TraceRecorder, device_memory_stats
 
 #: the keys every bench / grid-report / serving-sweep record must carry.
@@ -24,10 +25,18 @@ def telemetry_block(
     timer=None,
     trace: Trace | None = None,
     device=None,
+    ledger=None,
+    ledger_since: dict | None = None,
 ) -> dict:
     """JSON-ready telemetry summary for a record: span totals (from a
     PhaseTimer), trace id + event count (from a Trace), recorder counters,
-    and the device-memory watermark at assembly time."""
+    the device-memory watermark at assembly time, and the executable cost
+    ledger (identity + FLOPs/bytes + compile time + roofline per compiled
+    program — ``ledger`` defaults to the process ledger). Producers pass
+    ``ledger_since`` (a ``CostLedger.mark()`` taken at run start) so the
+    record's ``cost`` block covers *this run's* executables, not the
+    process lifetime — on a shared-engine grid the difference is every
+    warm point otherwise re-reporting the first point's compiles."""
     block: dict = {"hbm": device_memory_stats(device)}
     if timer is not None:
         block["spans_s"] = {k: round(v, 4) for k, v in timer.spans.items()}
@@ -38,16 +47,28 @@ def telemetry_block(
     if recorder is not None:
         block["events_emitted"] = recorder.events_emitted
         block["counters"] = {k: int(v) for k, v in recorder.counters.items()}
+    block["cost"] = (ledger if ledger is not None else get_ledger()).cost_block(
+        since=ledger_since
+    )
     return block
 
 
 def validate_record(record: dict, kind: str = "record") -> dict:
-    """Assert ``record`` carries the shared schema keys; returns it."""
+    """Assert ``record`` carries the shared schema keys — including the
+    ``telemetry.cost`` sub-block (the executable cost ledger); returns it."""
     missing = [k for k in REQUIRED_RECORD_KEYS if k not in record]
     if missing:
         raise ValueError(
             f"{kind} record is missing schema keys {missing}: every "
             f"bench/grid/serving record must carry {list(REQUIRED_RECORD_KEYS)}"
+        )
+    telemetry = record.get("telemetry")
+    if not isinstance(telemetry, dict) or "cost" not in telemetry:
+        raise ValueError(
+            f"{kind} record's telemetry block is missing the 'cost' "
+            "sub-block: assemble it with observability.records."
+            "telemetry_block so the executable cost ledger travels with "
+            "every committed number"
         )
     return record
 

@@ -49,7 +49,7 @@ from ..experiments.common import (  # noqa: F401 — BucketMenu/RequestTooLarge
     RequestTooLarge,  # compaction path all consume ONE size source of truth
     pad_states,
 )
-from ..observability import Trace, use_trace
+from ..observability import Trace, ledger_context, use_trace
 
 
 class QueueFull(Exception):
@@ -310,16 +310,19 @@ class Microbatcher:
                 break
         t0 = self.clock()
         try:
-            if bt is None:
-                out = np.asarray(dispatch(x_pad))
-            else:
-                with use_trace(bt), bt.span(
-                    "dispatch",
-                    bucket=bucket,
-                    rows=rows_total,
-                    requests=len(batch),
-                ):
+            # every executable compiled under this dispatch records the
+            # bucket it was built for — the cost ledger's serving identity
+            with ledger_context(bucket=int(bucket), batch_requests=len(batch)):
+                if bt is None:
                     out = np.asarray(dispatch(x_pad))
+                else:
+                    with use_trace(bt), bt.span(
+                        "dispatch",
+                        bucket=bucket,
+                        rows=rows_total,
+                        requests=len(batch),
+                    ):
+                        out = np.asarray(dispatch(x_pad))
             if out.shape[0] != bucket:
                 raise ValueError(
                     f"dispatch returned leading axis {out.shape[0]}, "

@@ -46,6 +46,7 @@ from ..observability import (
     build_identity,
     current_trace,
     device_memory_stats,
+    get_ledger,
     maybe_span,
 )
 from ..utils.config import get_dict_hash
@@ -59,18 +60,35 @@ class InvalidRequest(ValueError):
 
 def _record_device_span(bt, engine, traces0: int, t0: float, **extra) -> None:
     """The one device-span shape both dispatch closures emit: compile vs run
-    split by the engine's ``trace_count`` delta, HBM watermark attached."""
+    split by the engine's ``trace_count`` delta, HBM watermark attached,
+    and — when the cost ledger knows the dispatched executables — roofline
+    attribution (model FLOPs joined with this span's duration)."""
     if bt is None:
         return
     traced = engine.trace_count - traces0
-    bt.record_span(
-        "device_compile" if traced else "device_run",
-        time.perf_counter() - t0,
+    dur = time.perf_counter() - t0
+    executables = list(getattr(engine, "last_run_executables", ()))
+    attrs = dict(
         traces=int(traced),
         hbm=device_memory_stats(
             engine.mesh.devices.flat[0] if engine.mesh is not None else None
         ),
         **extra,
+    )
+    if executables:
+        attrs["executables"] = executables
+        # roofline only on pure run spans: a device_compile span's duration
+        # is dominated by compile, and achieved-FLOP/s over it would read
+        # orders of magnitude below the replica's real rate
+        if not traced:
+            counts = getattr(engine, "last_run_dispatch_counts", None)
+            roofline = get_ledger().roofline_for(
+                counts or executables, dur
+            )
+            if roofline is not None:
+                attrs["roofline"] = roofline
+    bt.record_span(
+        "device_compile" if traced else "device_run", dur, **attrs
     )
 
 
@@ -526,7 +544,28 @@ class AttackService:
             "queue_depth_rows": self.batcher.queue_depth_rows(),
             "bucket_menu": list(self.menu.sizes),
             "build": dict(self._build, meshes=meshes),
+            # cost-ledger summary next to the build identity: executable
+            # count, total compile seconds, executable-cache hit ratio —
+            # a replica that recompiles on every request shows up here
+            # before it shows up in latency
+            "ledger": get_ledger().summary(),
+            "caches": {
+                "engine": dict(
+                    common.ENGINES.stats(),
+                    recompile_causes=common.ENGINES.recompile_causes[
+                        -self.RECOMPILE_CAUSES_SHOWN :
+                    ],
+                ),
+                "artifact": common.ARTIFACTS.stats(),
+                "executable_recompile_causes": get_ledger().recompile_causes[
+                    -self.RECOMPILE_CAUSES_SHOWN :
+                ],
+            },
         }
+
+    #: most-recent recompile causes surfaced on /healthz (full, bounded
+    #: lists stay on the caches/ledger themselves)
+    RECOMPILE_CAUSES_SHOWN = 8
 
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
@@ -537,6 +576,9 @@ class AttackService:
             "spans_enabled": self.recorder.spans_enabled,
             "events_emitted": self.recorder.events_emitted,
         }
+        # per-executable identity + cost + roofline: JSON here, labeled
+        # gauges under /metrics?format=prom (observability.prom)
+        snap["cost_ledger"] = get_ledger().cost_block()
         return snap
 
     def close(self):

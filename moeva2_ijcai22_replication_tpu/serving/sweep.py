@@ -20,7 +20,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Sequence
 
-from ..observability import telemetry_block, validate_record
+from ..observability import get_ledger, telemetry_block, validate_record
 from ..utils.observability import percentile
 from .batcher import DeadlineExceeded, QueueFull, RequestTooLarge
 from .service import AttackRequest, AttackService
@@ -125,6 +125,9 @@ def offered_load_sweep(
 ) -> dict:
     """Sweep the rate ladder; returns the ``serving`` bench record:
     per-level results plus the service-side counter/cache totals."""
+    # cost window: the record's telemetry.cost covers the sweep's own
+    # dispatches (warmup compiles paid before this call stay out)
+    ledger_mark = get_ledger().mark()
     levels = [
         run_level(service, make_request, rps, n_requests, **kw)
         for rps in offered_rps_levels
@@ -146,7 +149,9 @@ def offered_load_sweep(
                 "max_delay_s": service.batcher.max_delay_s,
                 "resolved_run_configs": snap["resolved_run_configs"],
             },
-            "telemetry": telemetry_block(recorder=service.recorder),
+            "telemetry": telemetry_block(
+                recorder=service.recorder, ledger_since=ledger_mark
+            ),
         },
         "serving",
     )

@@ -1,0 +1,920 @@
+"""Executable cost ledger, roofline attribution, and the bench_diff
+perf-regression watchdog.
+
+Core (hardware-free): the cost/memory-analysis probes degrade gracefully
+on backends without a cost model (satellite: ``cost_available: false``
+instead of a crash), LedgeredJit compiles AOT exactly once per argument
+signature and dispatches the identical executable, recompile causes name
+the key fields that differed, and the roofline math joins model FLOPs
+with attributed run seconds.
+
+Producers (tier-1 acceptance): a PGD engine, a MoEvA engine (init +
+segment + success-gate programs), and a serving smoke through the
+microbatcher all land in the process ledger with identity (rows, loss
+strategy, bucket) and compile wall-clock — and the overhead smoke proves
+ledger-off runs dispatch the same number of programs and produce
+bit-identical outputs.
+
+Watchdog: ``tools/bench_diff.py`` threshold logic on fixture records
+(passes on improvement and on cost-explained shape changes, fails on an
+injected 2x slowdown) plus the repo check over the committed
+``BENCH_r*.json`` series.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.observability import (
+    LEDGER,
+    CostLedger,
+    LedgeredJit,
+    get_ledger,
+    ledger_context,
+    telemetry_block,
+    validate_record,
+)
+from moeva2_ijcai22_replication_tpu.observability.ledger import (
+    probe_cost_analysis,
+    probe_memory_analysis,
+)
+from moeva2_ijcai22_replication_tpu.observability.prom import prometheus_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_ledger():
+    """Each test sees an empty process ledger (engines record into the
+    global one; entries from other test modules must not leak in)."""
+    LEDGER.reset()
+    LEDGER.enabled = True
+    yield
+    LEDGER.reset()
+    LEDGER.enabled = True
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# probes: graceful degradation when the backend has no cost model
+# ---------------------------------------------------------------------------
+
+
+class TestProbes:
+    def test_cost_probe_handles_raising_none_and_empty(self):
+        class Raises:
+            def cost_analysis(self):
+                raise NotImplementedError("no cost model on this backend")
+
+        class ReturnsNone:
+            def cost_analysis(self):
+                return None
+
+        class Empty:
+            def cost_analysis(self):
+                return []
+
+        assert probe_cost_analysis(Raises()) is None
+        assert probe_cost_analysis(ReturnsNone()) is None
+        assert probe_cost_analysis(Empty()) is None
+
+    def test_cost_probe_accepts_list_and_dict_shapes(self):
+        class AsList:
+            def cost_analysis(self):
+                return [{"flops": 10.0, "bytes accessed": 40.0}]
+
+        class AsDict:
+            def cost_analysis(self):
+                return {"flops": 7, "transcendentals": 2}
+
+        assert probe_cost_analysis(AsList()) == {
+            "flops": 10.0,
+            "bytes_accessed": 40.0,
+        }
+        assert probe_cost_analysis(AsDict()) == {
+            "flops": 7.0,
+            "transcendentals": 2.0,
+        }
+
+    def test_memory_probe_handles_raising_and_none(self):
+        class Raises:
+            def memory_analysis(self):
+                raise RuntimeError("unimplemented")
+
+        class ReturnsNone:
+            def memory_analysis(self):
+                return None
+
+        assert probe_memory_analysis(Raises()) is None
+        assert probe_memory_analysis(ReturnsNone()) is None
+
+    def test_no_cost_model_records_cost_available_false(self, monkeypatch):
+        """The satellite contract: a backend returning no cost model yields
+        a ledger entry with ``cost_available: false`` — never a crash, and
+        the dispatch result is unaffected."""
+        import jax
+        import jax.numpy as jnp
+
+        from moeva2_ijcai22_replication_tpu.observability import ledger as L
+
+        monkeypatch.setattr(
+            L, "probe_cost_analysis", lambda c: (_ for _ in ()).throw(
+                RuntimeError("boom")
+            ) if False else None
+        )
+        monkeypatch.setattr(L, "probe_memory_analysis", lambda c: None)
+        led = CostLedger()
+        lj = LedgeredJit(
+            jax.jit(lambda x: x * 2), producer="p", ledger=led
+        )
+        out = lj(jnp.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(out), np.arange(4.0) * 2)
+        (entry,) = led.entries()
+        assert entry.cost_available is False
+        assert entry.flops is None and entry.memory is None
+        assert entry.aot is True
+
+
+# ---------------------------------------------------------------------------
+# LedgeredJit: AOT capture, caching, fallback
+# ---------------------------------------------------------------------------
+
+
+class TestLedgeredJit:
+    def test_compiles_once_per_signature_and_records(self):
+        import jax
+        import jax.numpy as jnp
+
+        led = CostLedger()
+        lj = LedgeredJit(
+            jax.jit(lambda x: (x * x).sum()),
+            producer="toy",
+            identity={"family": "square"},
+            describe_args=lambda x: {"rows": int(x.shape[0])},
+            ledger=led,
+        )
+        a = jnp.arange(8.0)
+        r1 = lj(a)
+        r2 = lj(a)
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+        (entry,) = led.entries()
+        assert entry.producer == "toy"
+        assert entry.identity["family"] == "square"
+        assert entry.identity["rows"] == 8
+        assert entry.compile_s > 0
+        assert entry.dispatches == 2
+        assert led.hits == 1 and led.misses == 1
+        assert lj.calls == 2
+        # CPU in this jax version ships a cost model: the acceptance run
+        # records real FLOPs (other backends may legitimately record None)
+        assert entry.cost_available in (True, False)
+
+    def test_new_shape_compiles_new_entry_with_recompile_cause(self):
+        import jax
+        import jax.numpy as jnp
+
+        led = CostLedger()
+        lj = LedgeredJit(
+            jax.jit(lambda x: x + 1),
+            producer="toy",
+            describe_args=lambda x: {"rows": int(x.shape[0])},
+            ledger=led,
+        )
+        lj(jnp.arange(8.0))
+        lj(jnp.arange(16.0))
+        assert len(led.entries()) == 2
+        (cause,) = led.recompile_causes
+        assert cause["producer"] == "toy"
+        assert cause["changed"] == {"rows": {"from": 8, "to": 16}}
+
+    def test_static_kwargs_partition_the_cache(self):
+        import jax
+        import jax.numpy as jnp
+
+        led = CostLedger()
+        lj = LedgeredJit(
+            jax.jit(
+                lambda x, length: jax.lax.scan(
+                    lambda c, _: (c + 1.0, None), x, None, length=length
+                )[0],
+                static_argnames="length",
+            ),
+            producer="scan",
+            describe_args=lambda x, **kw: {"length": kw.get("length")},
+            static_argnames=("length",),
+            ledger=led,
+        )
+        a = jnp.zeros(4)
+        out3 = lj(a, length=3)
+        out5 = lj(a, length=5)
+        assert float(np.asarray(out3)[0]) == 3.0
+        assert float(np.asarray(out5)[0]) == 5.0
+        assert len(led.entries()) == 2
+        (cause,) = led.recompile_causes
+        assert "length" in cause["changed"]
+
+    def test_lowering_failure_falls_back_to_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        class NoAotJit:
+            """A jitted callable whose AOT path is broken (older jax /
+            exotic backend): dispatch must fall back to the jit path."""
+
+            def __init__(self, f):
+                self._f = jax.jit(f)
+
+            def __call__(self, *a, **k):
+                return self._f(*a, **k)
+
+            def lower(self, *a, **k):
+                raise RuntimeError("no AOT on this backend")
+
+        led = CostLedger()
+        lj = LedgeredJit(NoAotJit(lambda x: x - 1), producer="fallback", ledger=led)
+        out = lj(jnp.arange(3.0))
+        np.testing.assert_array_equal(np.asarray(out), np.arange(3.0) - 1)
+        (entry,) = led.entries()
+        assert entry.aot is False and entry.cost_available is False
+        assert entry.dispatches == 1
+        # the real trace+compile happened inside the first jit call: it is
+        # booked as compile (on the entry AND in last_call_compile_s, so
+        # engine run attribution keeps compile out of run seconds)
+        assert lj.last_call_compile_s > 0
+        assert entry.compile_s >= lj.last_call_compile_s * 0.5
+        # warm call: no compile consumed
+        lj(jnp.arange(3.0))
+        assert lj.last_call_compile_s == 0.0
+
+    def test_disabled_ledger_still_dispatches_identically(self):
+        import jax
+        import jax.numpy as jnp
+
+        led = CostLedger(enabled=False)
+        lj = LedgeredJit(jax.jit(lambda x: x * 3), producer="off", ledger=led)
+        out = lj(jnp.arange(5.0))
+        np.testing.assert_array_equal(np.asarray(out), np.arange(5.0) * 3)
+        assert led.entries() == []  # nothing recorded...
+        assert led.misses == 1  # ...but the compile still counted
+        assert lj.calls == 1
+
+    def test_ledger_context_merges_into_identity(self):
+        import jax
+        import jax.numpy as jnp
+
+        led = CostLedger()
+        lj = LedgeredJit(jax.jit(lambda x: x), producer="ctx", ledger=led)
+        with ledger_context(bucket=64, batch_requests=3):
+            lj(jnp.arange(2.0))
+        (entry,) = led.entries()
+        assert entry.identity["bucket"] == 64
+        assert entry.identity["batch_requests"] == 3
+
+
+# ---------------------------------------------------------------------------
+# ledger core: roofline math, summaries, cost block
+# ---------------------------------------------------------------------------
+
+
+class TestLedgerCore:
+    def _entry(self, led, producer="synth", flops=2e9, bytes_=1e8):
+        return led.record_compile(
+            producer=producer,
+            identity={"rows": 64},
+            backend="cpu",
+            compile_s=1.5,
+            cost={"flops": flops, "bytes_accessed": bytes_},
+            memory={"argument_bytes": 1024, "temp_bytes": 256},
+        )
+
+    def test_roofline_math_on_synthetic_spans(self):
+        """2 GFLOP program, 4 dispatches attributed 2 s of device_run
+        spans -> 4 GFLOP/s achieved; intensity = flops / bytes."""
+        led = CostLedger()
+        e = self._entry(led)
+        for _ in range(4):
+            led.record_dispatch(e.key)
+        led.add_run_seconds(e.key, 1.25)
+        led.add_run_seconds(e.key, 0.75)
+        r = e.roofline()
+        assert r["dispatches"] == 4
+        assert r["run_s"] == 2.0
+        assert r["achieved_flops_s"] == pytest.approx(4e9)
+        assert r["achieved_bytes_s"] == pytest.approx(2e8)
+        assert r["arithmetic_intensity"] == pytest.approx(20.0)
+
+    def test_roofline_without_runs_or_cost(self):
+        led = CostLedger()
+        e = led.record_compile(
+            producer="p", identity={}, backend="cpu", compile_s=0.1,
+            cost=None, memory=None,
+        )
+        r = e.roofline()
+        assert r["achieved_flops_s"] is None
+        assert r["arithmetic_intensity"] is None
+        assert e.cost_available is False
+
+    def test_roofline_for_joins_span_duration(self):
+        led = CostLedger()
+        e1 = self._entry(led, flops=1e9, bytes_=1e8)
+        e2 = self._entry(led, flops=3e9, bytes_=1e8)
+        r = led.roofline_for([e1.key, e2.key], seconds=2.0)
+        assert r["flops"] == pytest.approx(4e9)
+        assert r["achieved_flops_s"] == pytest.approx(2e9)
+        # dispatch-count mapping: a span chaining one executable 5 times
+        # must count its flops 5 times
+        r5 = led.roofline_for({e1.key: 5}, seconds=2.0)
+        assert r5["flops"] == pytest.approx(5e9)
+        assert r5["achieved_flops_s"] == pytest.approx(2.5e9)
+        assert led.roofline_for([e1.key], seconds=0.0) is None
+        assert led.roofline_for(["missing"], seconds=1.0) is None
+
+    def test_mark_scopes_cost_block_to_the_window(self):
+        """A record's telemetry.cost must cover the run that produced it:
+        earlier compiles are excluded, re-dispatched warm executables
+        appear with compile 0 and delta dispatch/run numbers."""
+        led = CostLedger()
+        e1 = self._entry(led, flops=1e9)
+        led.record_dispatch(e1.key)
+        led.add_run_seconds(e1.key, 1.0)
+        mark = led.mark()
+
+        # warm re-dispatch of e1 inside the window + one new compile
+        led.record_hit()
+        led.record_dispatch(e1.key)
+        led.add_run_seconds(e1.key, 0.5)
+        e2 = self._entry(led, producer="new", flops=2e9)
+        led.record_dispatch(e2.key)
+
+        block = led.cost_block(since=mark)
+        rows = {r["key"]: r for r in block["entries"]}
+        assert set(rows) == {e1.key, e2.key}
+        # e1 compiled BEFORE the window: compile charged 0, deltas only
+        assert rows[e1.key]["compile_s"] == 0.0
+        assert rows[e1.key]["dispatches"] == 1
+        assert rows[e1.key]["run_s"] == 0.5
+        assert rows[e1.key]["achieved_flops_s"] == pytest.approx(2e9)
+        # e2 compiled inside: full compile time
+        assert rows[e2.key]["compile_s"] == 1.5
+        assert block["compile_s_total"] == 1.5
+        assert block["cache_hits"] == 1 and block["cache_misses"] == 1
+        assert block["flops_total"] == pytest.approx(1e9 + 2e9)
+        # an executable untouched in the window stays out entirely
+        mark2 = led.mark()
+        assert led.cost_block(since=mark2)["entries"] == []
+        assert led.cost_block(since=mark2)["flops_total"] is None
+
+    def test_summary_and_delta(self):
+        led = CostLedger()
+        e = self._entry(led)
+        led.record_dispatch(e.key)
+        before = led.summary()
+        assert before["executables"] == 1
+        assert before["compile_s_total"] == 1.5
+        assert before["cost_available"] is True
+        e2 = self._entry(led, producer="other")
+        led.record_dispatch(e2.key)
+        led.record_hit()
+        delta = led.summary_delta(before)
+        assert delta["executables"] == 1
+        assert delta["compile_s_total"] == 1.5
+        assert delta["cache_hits"] == 1 and delta["cache_misses"] == 1
+        assert delta["cache_hit_ratio"] == 0.5
+
+    def test_cost_block_is_json_ready_and_carries_entries(self):
+        led = CostLedger()
+        self._entry(led)
+        block = led.cost_block()
+        json.dumps(block)
+        (row,) = block["entries"]
+        assert row["identity"]["rows"] == 64
+        assert row["memory"]["argument_bytes"] == 1024
+        assert {"flops", "compile_s", "achieved_flops_s"} <= set(row)
+
+    def test_recompile_cause_picks_nearest_entry(self):
+        led = CostLedger()
+        led.record_compile(
+            producer="p", identity={"rows": 8, "loss": "flip"},
+            backend="cpu", compile_s=0.1, cost=None, memory=None,
+        )
+        led.record_compile(
+            producer="p", identity={"rows": 8, "loss": "constraints"},
+            backend="cpu", compile_s=0.1, cost=None, memory=None,
+        )
+        led.record_compile(
+            producer="p", identity={"rows": 16, "loss": "constraints"},
+            backend="cpu", compile_s=0.1, cost=None, memory=None,
+        )
+        assert len(led.recompile_causes) == 2
+        # the third compile diffs against its nearest neighbour (entry 2):
+        # only `rows` changed, not `loss`
+        last = led.recompile_causes[-1]
+        assert list(last["changed"]) == ["rows"]
+        assert last["changed"]["rows"] == {"from": 8, "to": 16}
+
+    def test_validator_requires_cost_sub_block(self):
+        with pytest.raises(ValueError, match="cost"):
+            validate_record(
+                {"execution": {}, "telemetry": {"hbm": None}}, "bench"
+            )
+        rec = {"execution": {}, "telemetry": telemetry_block()}
+        assert validate_record(rec, "bench") is rec
+        assert rec["telemetry"]["cost"]["enabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# producers: engines + serving populate the process ledger
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Synthetic-LCLD artifact family (same shape as test_tracing's) —
+    dataset- and hardware-free."""
+    import joblib
+    from sklearn.preprocessing import MinMaxScaler
+
+    from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_lcld,
+        synth_lcld_schema,
+    )
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+
+    tmp = tmp_path_factory.mktemp("ledger_artifacts")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(64, cons.schema, seed=5)
+    cons.check_constraints_error(x)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=6))
+    save_params(sur, str(tmp / "nn.msgpack"))
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    joblib.dump(
+        MinMaxScaler().fit(np.vstack([x, xl, xu])), tmp / "scaler.joblib"
+    )
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    return {
+        "pool": x,
+        "cons": cons,
+        "sur": sur,
+        "scaler": fit_minmax(np.vstack([x, xl, xu]).min(0),
+                             np.vstack([x, xl, xu]).max(0)),
+        "domain": {
+            "project_name": "lcld",
+            "norm": 2,
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": paths["features"],
+                "constraints": paths["constraints"],
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "system": {"mesh_devices": 0},
+        },
+    }
+
+
+def _pgd(artifacts, **kw):
+    from moeva2_ijcai22_replication_tpu.attacks.pgd import ConstrainedPGD
+
+    kw.setdefault("max_iter", 3)
+    return ConstrainedPGD(
+        classifier=artifacts["sur"],
+        constraints=artifacts["cons"],
+        scaler=artifacts["scaler"],
+        **kw,
+    )
+
+
+class TestProducers:
+    def test_pgd_engine_populates_ledger(self, artifacts):
+        pgd = _pgd(artifacts)
+        xs = np.asarray(artifacts["scaler"].transform(artifacts["pool"][:8]))
+        y = np.asarray(artifacts["sur"].predict_proba(xs)).argmax(-1)
+        pgd.generate(xs, y)
+        pgd.generate(xs, y)  # executable-cache hit, one more dispatch
+        (entry,) = [
+            e for e in LEDGER.entries() if e.producer == "pgd_attack"
+        ]
+        assert entry.identity["engine"] == "ConstrainedPGD"
+        assert entry.identity["loss_evaluation"] == "flip"
+        assert entry.identity["rows"] == 8
+        assert entry.compile_s > 0
+        assert entry.dispatches == 2
+        assert entry.run_s > 0  # attributed at the fetch sync point
+        assert pgd.trace_count == 1  # one trace per executable, as before
+        assert pgd.last_run_executables == [entry.key]
+
+    def test_moeva_engine_populates_init_segment_success(self, artifacts):
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+
+        moeva = Moeva2(
+            classifier=artifacts["sur"],
+            constraints=artifacts["cons"],
+            ml_scaler=artifacts["scaler"],
+            norm=2,
+            n_gen=5,
+            n_pop=8,
+            n_offsprings=4,
+            seed=11,
+            archive_size=2,
+            early_stop_check_every=2,
+        )
+        moeva.generate(artifacts["pool"][:4], 1)
+        producers = {e.producer for e in LEDGER.entries()}
+        # all three MoEvA program families, including the success-gate probe
+        assert {"moeva_init", "moeva_segment", "moeva_success"} <= producers
+        seg = next(
+            e for e in LEDGER.entries() if e.producer == "moeva_segment"
+        )
+        assert seg.identity["rows"] == 4
+        assert seg.identity["length"] == 2  # gate every 2 generations
+        assert seg.identity["n_pop"] == 8
+        assert seg.compile_s > 0
+        # run attribution covered the whole generate (compile excluded)
+        assert sum(e.run_s for e in LEDGER.entries()) > 0
+        assert set(moeva.last_run_executables) <= {
+            e.key for e in LEDGER.entries()
+        }
+
+    def test_serving_microbatcher_bucket_lands_in_identity(self, artifacts):
+        from moeva2_ijcai22_replication_tpu.serving import (
+            AttackRequest,
+            AttackService,
+        )
+
+        svc = AttackService(
+            {"lcld": artifacts["domain"]},
+            bucket_sizes=(8,),
+            max_delay_s=0.01,
+        )
+        try:
+            resp = svc.attack(
+                AttackRequest(
+                    domain="lcld",
+                    x=artifacts["pool"][:3],
+                    eps=0.2,
+                    budget=2,
+                ),
+                timeout=300.0,
+            )
+            assert resp.x_adv.shape[0] == 3
+            entries = [
+                e for e in LEDGER.entries() if e.producer == "pgd_attack"
+            ]
+            assert entries, "serving dispatch must land in the ledger"
+            entry = entries[0]
+            # microbatcher context: the executable knows its bucket
+            assert entry.identity["bucket"] == 8
+            assert entry.identity["rows"] == 8  # padded to the bucket
+            assert entry.identity["batch_requests"] == 1
+            # engine-cache identity joined in (built through ENGINES)
+            assert entry.identity["cache_key"] is not None
+
+            # /healthz: ledger summary + cache introspection next to build
+            health = svc.healthz()
+            assert health["ledger"]["executables"] >= 1
+            assert health["ledger"]["compile_s_total"] > 0
+            assert "cache_hit_ratio" in health["ledger"]
+            assert "recompile_causes" in health["caches"]["engine"]
+            assert "evictions" in health["caches"]["artifact"]
+
+            # /metrics: cost ledger in the JSON snapshot and as labeled
+            # Prometheus gauges
+            snap = svc.metrics_snapshot()
+            assert snap["cost_ledger"]["executables"] >= 1
+            text = prometheus_text(snap)
+            assert "moeva2_cost_ledger_executables 1" in text
+            assert "moeva2_executable_compile_s{" in text
+            assert 'producer="pgd_attack"' in text
+
+            # meta.trace roofline: re-request with tracing on (same cached
+            # engine/executable — zero new compiles)
+            from moeva2_ijcai22_replication_tpu.observability import (
+                TraceRecorder,
+            )
+        finally:
+            svc.close()
+
+        rec = TraceRecorder(spans_enabled=True)
+        svc2 = AttackService(
+            {"lcld": artifacts["domain"]},
+            bucket_sizes=(8,),
+            max_delay_s=0.01,
+            recorder=rec,
+        )
+        try:
+            resp2 = svc2.attack(
+                AttackRequest(
+                    domain="lcld", x=artifacts["pool"][:3], eps=0.2, budget=2
+                ),
+                timeout=300.0,
+            )
+            flat, stack = [], list(resp2.meta["trace"])
+            while stack:
+                node = stack.pop()
+                flat.append(node)
+                stack.extend(node.get("children", ()))
+            dev = next(
+                n
+                for n in flat
+                if n["name"] in ("device_run", "device_compile")
+            )
+            assert dev["attrs"]["executables"]
+            if LEDGER.entries()[0].flops is not None:
+                assert dev["attrs"]["roofline"]["achieved_flops_s"] > 0
+        finally:
+            svc2.close()
+
+    def test_grid_report_carries_ledger_delta(self):
+        from moeva2_ijcai22_replication_tpu.experiments.pipeline import (
+            GridPipeline,
+        )
+        from moeva2_ijcai22_replication_tpu.observability import TraceRecorder
+
+        gp = GridPipeline(recorder=TraceRecorder(spans_enabled=False))
+        LEDGER.record_compile(
+            producer="p", identity={}, backend="cpu", compile_s=0.5,
+            cost=None, memory=None,
+        )
+        report = gp.finish({"system": {"mesh_devices": 0}}, [])
+        assert report["ledger"]["executables"] == 1
+        assert report["ledger"]["compile_s_total"] == 0.5
+        assert "cost" in report["telemetry"]
+        assert validate_record(report, "grid") is report
+
+
+class TestLedgerOverhead:
+    def test_ledger_off_is_bit_identical_with_zero_extra_dispatches(
+        self, artifacts
+    ):
+        """Tier-1 smoke: toggling the ledger changes bookkeeping only —
+        same dispatch count, same trace count, bit-identical outputs."""
+        xs = np.asarray(artifacts["scaler"].transform(artifacts["pool"][:8]))
+        y = np.asarray(artifacts["sur"].predict_proba(xs)).argmax(-1)
+
+        LEDGER.enabled = True
+        pgd_on = _pgd(artifacts)
+        out_on = pgd_on.generate(xs, y)
+        n_entries_on = len(LEDGER.entries())
+        assert n_entries_on == 1
+
+        LEDGER.enabled = False
+        pgd_off = _pgd(artifacts)
+        out_off = pgd_off.generate(xs, y)
+        assert len(LEDGER.entries()) == n_entries_on  # nothing new recorded
+
+        # bit-identical numerics
+        np.testing.assert_array_equal(out_on, out_off)
+        # zero extra dispatches and zero extra compiles either way
+        assert pgd_on._jit_attack.calls == pgd_off._jit_attack.calls == 1
+        assert pgd_on.trace_count == pgd_off.trace_count == 1
+
+    def test_moeva_ledger_toggle_bit_identical(self, artifacts):
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+
+        def run():
+            m = Moeva2(
+                classifier=artifacts["sur"],
+                constraints=artifacts["cons"],
+                ml_scaler=artifacts["scaler"],
+                norm=2,
+                n_gen=4,
+                n_pop=8,
+                n_offsprings=4,
+                seed=13,
+            )
+            res = m.generate(artifacts["pool"][:4], 1)
+            return res, m
+
+        LEDGER.enabled = True
+        res_on, m_on = run()
+        LEDGER.enabled = False
+        res_off, m_off = run()
+        np.testing.assert_array_equal(res_on.x_gen, res_off.x_gen)
+        np.testing.assert_array_equal(res_on.f, res_off.f)
+        assert m_on.trace_count == m_off.trace_count
+        assert (
+            m_on._jit_segment.calls + m_on._jit_init.calls
+            == m_off._jit_segment.calls + m_off._jit_init.calls
+        )
+
+
+# ---------------------------------------------------------------------------
+# bench_diff: threshold logic + the repo check
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, name, rec):
+    p = tmp_path / name
+    p.write_text(json.dumps(rec))
+    return str(p)
+
+
+def _bench_record(steady=10.0, value=50.0, flops=None, shape=(1000, 1000)):
+    rec = {
+        "steady_s": steady,
+        "value": value,
+        "execution": {"n_states": shape[0], "n_gen": shape[1]},
+        "telemetry": {},
+    }
+    if flops is not None:
+        rec["telemetry"]["cost"] = {"flops_total": flops}
+    return rec
+
+
+class TestBenchDiff:
+    @pytest.fixture(scope="class")
+    def bench_diff(self):
+        return _load_tool("bench_diff")
+
+    def test_passes_on_improvement(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "r01.json", _bench_record(steady=10.0, value=50.0))
+        b = _write(tmp_path, "r02.json", _bench_record(steady=9.0, value=55.0))
+        assert bench_diff.main([a, b]) == 0
+
+    def test_fails_on_injected_2x_slowdown(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "r01.json", _bench_record(steady=10.0))
+        b = _write(tmp_path, "r02.json", _bench_record(steady=20.0))
+        assert bench_diff.main([a, b]) == 1
+
+    def test_cost_normalization_explains_shape_changes(
+        self, bench_diff, tmp_path
+    ):
+        """2x wall-clock with 2x ledger FLOPs is NOT a regression — and the
+        same wall-clock at constant FLOPs is."""
+        a = _write(
+            tmp_path, "r01.json", _bench_record(steady=10.0, flops=1e12)
+        )
+        b = _write(
+            tmp_path, "r02.json", _bench_record(steady=20.0, flops=2e12)
+        )
+        assert bench_diff.main([a, b]) == 0
+        c = _write(
+            tmp_path, "r03.json", _bench_record(steady=20.0, flops=1e12)
+        )
+        assert bench_diff.main([a, c]) == 1
+
+    def test_post_ledger_record_still_compares_by_shape(
+        self, bench_diff, tmp_path
+    ):
+        """A record carrying ledger FLOPs must still normalize by shape
+        against a pre-ledger record — otherwise an honest shape change
+        across the ledger boundary reads as a 2x raw regression."""
+        old = _write(
+            tmp_path,
+            "r01.json",
+            _bench_record(steady=10.0, shape=(1000, 1000)),  # pre-ledger
+        )
+        new = _write(
+            tmp_path,
+            "r02.json",
+            _bench_record(steady=20.0, flops=4e12, shape=(2000, 1000)),
+        )
+        assert bench_diff.main([old, new]) == 0
+
+    def test_shape_normalization_without_ledger(self, bench_diff, tmp_path):
+        a = _write(
+            tmp_path,
+            "r01.json",
+            _bench_record(steady=10.0, shape=(1000, 1000)),
+        )
+        b = _write(
+            tmp_path,
+            "r02.json",
+            _bench_record(steady=20.0, shape=(2000, 1000)),
+        )
+        assert bench_diff.main([a, b]) == 0
+
+    def test_threshold_is_configurable(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "r01.json", _bench_record(steady=10.0))
+        b = _write(tmp_path, "r02.json", _bench_record(steady=12.0))
+        assert bench_diff.main([a, b]) == 0  # 20% < default 25%
+        assert bench_diff.main([a, b, "--threshold", "0.1"]) == 1
+
+    def test_wrapper_format_and_crashed_records(self, bench_diff, tmp_path):
+        ok = _write(
+            tmp_path,
+            "r01.json",
+            {"n": 1, "rc": 0, "parsed": _bench_record(steady=10.0)},
+        )
+        crashed = _write(
+            tmp_path, "r02.json", {"n": 2, "rc": 1, "parsed": None}
+        )
+        slow = _write(
+            tmp_path,
+            "r03.json",
+            {"n": 3, "rc": 0, "parsed": _bench_record(steady=30.0)},
+        )
+        # crashed record is skipped, not treated as evidence
+        assert bench_diff.main([ok, crashed, slow]) == 1
+        # a single usable record passes trivially
+        assert bench_diff.main([ok, crashed]) == 0
+
+    def test_higher_is_better_metrics(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "r01.json", _bench_record(value=80.0))
+        b = _write(tmp_path, "r02.json", _bench_record(value=30.0))
+        assert bench_diff.main([a, b]) == 1
+
+    def test_argument_order_wins_over_lexical_order(
+        self, bench_diff, tmp_path
+    ):
+        """The CLI contract is oldest-first ARGUMENT order; a lexical
+        re-sort would flip before/after pairs whose names don't sort
+        chronologically and invert the regression direction."""
+        base = _write(tmp_path, "z_before.json", _bench_record(steady=10.0))
+        new = _write(tmp_path, "a_after.json", _bench_record(steady=20.0))
+        assert bench_diff.main([base, new]) == 1  # 2x slowdown caught
+        assert bench_diff.main([new, base]) == 0  # reversed = improvement
+
+
+class TestBenchDiffRepoCheck:
+    def test_committed_series_passes(self):
+        """The repo check tier-1 runs: regressions in a future PR's bench
+        record fail here. Committed records predate the ledger, so this
+        exercises the raw/shape fallback path too."""
+        import glob as _glob
+
+        series = sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        assert len(series) >= 2
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
+             "--check", *series],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bench_diff: ok" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# trace_export robustness (satellite): empty / truncated JSONL sinks
+# ---------------------------------------------------------------------------
+
+
+class TestTraceExportRobustness:
+    def test_truncated_last_line_is_skipped_with_warning(self, tmp_path):
+        from moeva2_ijcai22_replication_tpu.observability.export import (
+            read_jsonl,
+        )
+
+        p = tmp_path / "trace.jsonl"
+        p.write_text(
+            json.dumps({"kind": "meta", "t0_wall": 1.0}) + "\n"
+            + json.dumps({"kind": "event", "name": "e", "ts": 0.1}) + "\n"
+            + '{"kind": "span", "name": "cut-off mid-wr'  # no newline: crash
+        )
+        with pytest.warns(UserWarning, match="unparseable"):
+            events = read_jsonl(str(p))
+        assert [e["kind"] for e in events] == ["meta", "event"]
+        with pytest.raises(json.JSONDecodeError):
+            read_jsonl(str(p), strict=True)
+
+    def test_empty_sink_renders_empty_perfetto_doc(self, tmp_path):
+        from moeva2_ijcai22_replication_tpu.observability.export import (
+            read_jsonl,
+            to_chrome_trace,
+        )
+
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert read_jsonl(str(p)) == []
+        doc = to_chrome_trace([])
+        assert doc["traceEvents"] == []
+        json.dumps(doc)
+
+    def test_cli_survives_truncated_and_empty_files(self, tmp_path):
+        mod = _load_tool("trace_export")
+        for name, content in (
+            ("empty.jsonl", ""),
+            ("trunc.jsonl", '{"kind": "meta", "t0_'),
+        ):
+            p = tmp_path / name
+            p.write_text(content)
+            out = str(tmp_path / f"{name}.perfetto.json")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert mod.main([str(p), "-o", out]) == 0
+            with open(out) as fh:
+                doc = json.load(fh)
+            assert doc["traceEvents"] == []

@@ -172,7 +172,12 @@ class TestArtifactCache:
         a = cache.get("candidates", [str(path)], None, lambda: np.load(path))
         b = cache.get("candidates", [str(path)], None, lambda: np.load(path))
         assert a is b
-        assert cache.stats() == {"hits": 1, "misses": 1, "entries": 1}
+        assert cache.stats() == {
+            "hits": 1,
+            "misses": 1,
+            "evictions": 0,
+            "entries": 1,
+        }
 
     def test_invalidates_on_mtime_change(self, tmp_path):
         path = tmp_path / "x.npy"

@@ -341,7 +341,10 @@ class TestRecordSchema:
     def test_validate_record_rejects_missing_keys(self):
         with pytest.raises(ValueError, match="telemetry"):
             validate_record({"execution": {}}, "bench")
-        rec = {"execution": {}, "telemetry": {}}
+        # PR-5 cost ledger: telemetry must carry the cost sub-block too
+        with pytest.raises(ValueError, match="cost"):
+            validate_record({"execution": {}, "telemetry": {}}, "bench")
+        rec = {"execution": {}, "telemetry": {"cost": {}}}
         assert validate_record(rec) is rec
         assert set(REQUIRED_RECORD_KEYS) == {"execution", "telemetry"}
 

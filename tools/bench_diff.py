@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Perf-regression watchdog: diff bench records, normalized by ledger cost.
+
+The committed ``BENCH_r*.json`` series is the repo's performance
+trajectory; this tool turns it into an enforced contract. It compares the
+LATEST record against the best earlier value of each tracked metric and
+exits non-zero when a metric moved past the threshold in its bad
+direction — runnable standalone or as the repo check wired into tier-1
+(``tests/test_cost_ledger.py::TestBenchDiffRepoCheck``).
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json   # pairwise
+    python tools/bench_diff.py --check BENCH_r*.json           # whole series
+    python tools/bench_diff.py --check                         # globs BENCH_r*.json
+    python tools/bench_diff.py --check --threshold 0.4 ...
+
+Normalization: wall-clock metrics are divided by the work a record
+actually performed before comparison — the cost-ledger FLOPs total
+(``telemetry.cost.flops_total``) when both records carry it, else the
+benchmark shape (``execution.n_states * n_gen``) — so a PR that doubles
+the bench shape (and honestly reports it) does not masquerade as a 2x
+regression, and one that halves the shape cannot hide one. Records
+predating the ledger fall back to a raw comparison (the bench defaults
+have been stable) with the basis named in the output line.
+
+Records may be bare bench JSON or the committed driver wrapper
+``{"n", "cmd", "rc", "parsed"}``; wrappers with a non-zero rc or an
+empty payload are skipped (a crashed bench is not evidence of a
+regression — or of its absence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+
+#: relative slowdown (or throughput loss) that fails the check. The
+#: tunnelled bench host shows ~±10% run-to-run jitter (BASELINE.md), so
+#: the default trips at 2.5x that noise floor, far below the 2x class of
+#: regression this watchdog exists to catch.
+DEFAULT_THRESHOLD = 0.25
+
+
+def load_record(path: str) -> dict | None:
+    """Bench payload from ``path``; None when unusable (crashed/empty)."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_diff: skipping {path}: {e}", file=sys.stderr)
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc:  # committed driver wrapper
+        if doc.get("rc") not in (0, None):
+            print(
+                f"bench_diff: skipping {path}: bench exited rc={doc['rc']}",
+                file=sys.stderr,
+            )
+            return None
+        doc = doc.get("parsed")
+    return doc if isinstance(doc, dict) and doc else None
+
+
+def _get(rec: dict, dotted: str):
+    cur = rec
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _headline_work(rec: dict) -> dict:
+    """Every work basis the headline run's metadata supports (a record
+    carrying ledger FLOPs usually carries the bench shape too — both are
+    kept so it stays comparable with pre-ledger records via 'shape')."""
+    out = {}
+    cost = _get(rec, "telemetry.cost") or {}
+    flops = cost.get("flops_total")
+    if isinstance(flops, (int, float)) and flops > 0:
+        out["flops"] = float(flops)
+    ex = rec.get("execution") or {}
+    n_states, n_gen = ex.get("n_states"), ex.get("n_gen")
+    if n_states and n_gen:
+        out["shape"] = float(n_states) * float(n_gen)
+    return out
+
+
+def _botnet_work(rec: dict) -> dict:
+    rb = rec.get("real_botnet") or {}
+    if rb.get("n_states") and rb.get("n_gen"):
+        return {"shape": float(rb["n_states"]) * float(rb["n_gen"])}
+    return {}
+
+
+def _serving_best_throughput(rec: dict):
+    levels = _get(rec, "serving.levels") or []
+    vals = [
+        lv.get("throughput_rows_s")
+        for lv in levels
+        if isinstance(lv.get("throughput_rows_s"), (int, float))
+    ]
+    return max(vals) if vals else None
+
+
+#: (name, extractor, lower_is_better, work extractor or None)
+METRICS = (
+    ("steady_s", lambda r: r.get("steady_s"), True, _headline_work),
+    ("value (speedup)", lambda r: r.get("value"), False, None),
+    (
+        "real_botnet.steady_s",
+        lambda r: _get(r, "real_botnet.steady_s"),
+        True,
+        _botnet_work,
+    ),
+    (
+        "early_exit.speedup",
+        lambda r: _get(r, "early_exit.speedup"),
+        False,
+        None,
+    ),
+    (
+        "serving.throughput_rows_s (best level)",
+        _serving_best_throughput,
+        False,
+        None,
+    ),
+)
+
+
+#: normalization bases, strongest first: model FLOPs beat the benchmark
+#: shape beat an unnormalized comparison
+_BASES = ("flops", "shape", "raw")
+
+
+def _values_by_basis(rec: dict, extract, work_fn) -> dict:
+    """Every normalization of this record's metric value that its
+    metadata supports: ``{"raw": v}`` always (when the metric exists),
+    plus ``v / work`` per available work basis — ALL of them, so a
+    post-ledger record (flops + shape) still compares shape-normalized
+    against a pre-ledger one (shape only)."""
+    v = extract(rec)
+    if not isinstance(v, (int, float)):
+        return {}
+    out = {"raw": float(v)}
+    if work_fn is not None:
+        for kind, work in work_fn(rec).items():
+            if work:
+                out[kind] = float(v) / work
+    return out
+
+
+def diff_series(
+    records: list[tuple[str, dict]], threshold: float
+) -> tuple[list[str], bool]:
+    """Compare the last record pairwise against every earlier one, each
+    pair in the strongest normalization basis BOTH sides support (ledger
+    FLOPs > bench shape > raw), and judge the worst pair per metric.
+    Returns (report lines, any_regression)."""
+    lines: list[str] = []
+    regressed = False
+    latest_path, latest = records[-1]
+    earlier = records[:-1]
+    for name, extract, lower_better, work_fn in METRICS:
+        new_vals = _values_by_basis(latest, extract, work_fn)
+        if not new_vals:
+            lines.append(f"  {name}: absent in {latest_path} — skipped")
+            continue
+        pairs = []
+        for path, rec in earlier:
+            old_vals = _values_by_basis(rec, extract, work_fn)
+            basis = next(
+                (b for b in _BASES if b in old_vals and b in new_vals), None
+            )
+            if basis is None or old_vals[basis] == 0:
+                continue
+            new_v, old_v = new_vals[basis], old_vals[basis]
+            rel = (
+                (new_v - old_v) / old_v
+                if lower_better
+                else (old_v - new_v) / old_v
+            )
+            pairs.append((rel, path, old_v, new_v, basis))
+        if not pairs:
+            lines.append(f"  {name}: no comparable earlier record — skipped")
+            continue
+        rel, path, old_v, new_v, basis = max(pairs, key=lambda t: t[0])
+        bad = rel > threshold
+        regressed |= bad
+        direction = "worse" if rel > 0 else "better"
+        lines.append(
+            f"  {name}: {new_v:.6g} vs best {old_v:.6g} ({path}) "
+            f"[{basis}-normalized] -> {abs(rel) * 100:.1f}% {direction}"
+            + ("  ** REGRESSION **" if bad else "")
+        )
+    return lines, regressed
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "records",
+        nargs="*",
+        help="bench record files, oldest first (e.g. BENCH_r*.json)",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="repo-check mode: with no files, glob BENCH_r*.json in cwd",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=DEFAULT_THRESHOLD,
+        help=f"relative regression that fails (default {DEFAULT_THRESHOLD})",
+    )
+    args = parser.parse_args(argv)
+
+    paths = list(args.records)
+    if not paths and args.check:
+        paths = sorted(glob.glob("BENCH_r*.json"))
+    if not paths:
+        parser.error("no bench records given (and --check found none)")
+
+    # records are taken in the order GIVEN (oldest first, per the CLI
+    # contract) — re-sorting lexically would silently pick the wrong
+    # "latest" for names like before.json/after.json; the --check default
+    # glob above is sorted because BENCH_r%02d names sort chronologically
+    records = []
+    for p in paths:
+        rec = load_record(p)
+        if rec is not None:
+            records.append((p, rec))
+    if len(records) < 2:
+        print(
+            f"bench_diff: {len(records)} usable record(s) — nothing to "
+            "diff, trivially passing"
+        )
+        return 0
+
+    print(
+        f"bench_diff: {records[-1][0]} vs {len(records) - 1} earlier "
+        f"record(s), threshold {args.threshold:.0%}"
+    )
+    lines, regressed = diff_series(records, args.threshold)
+    print("\n".join(lines))
+    if regressed:
+        print("bench_diff: REGRESSION past threshold — failing")
+        return 1
+    print("bench_diff: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,11 @@ def main(argv=None) -> int:
     out = args.out or f"{args.path}.perfetto.json"
     with open(out, "w") as fh:
         json.dump(doc, fh)
+    if not events:
+        # an empty or fully-truncated sink still yields a valid (empty)
+        # Perfetto document — warn instead of stack-tracing
+        print(f"warning: {args.path} contained no parseable trace events",
+              file=sys.stderr)
     print(
         f"{len(events)} trace events -> {len(doc['traceEvents'])} "
         f"trace-event records -> {out}"

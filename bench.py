@@ -229,10 +229,19 @@ def run_real_botnet() -> dict | None:
         x = np.load(f"{base}/data/botnet/x_candidates_common.npy")
         sur = load_classifier(f"{base}/models/botnet/nn.model")
         scaler = load_joblib_scaler(f"{base}/models/botnet/scaler.joblib")
+        from moeva2_ijcai22_replication_tpu.observability import quality_block
+
         moeva = Moeva2(
             classifier=sur, constraints=cons, ml_scaler=scaler,
             norm=2, n_gen=n_gen, n_pop=200, n_offsprings=100, seed=42,
             archive_size=24,  # the production default (config/moeva.yaml)
+            # convergence telemetry: quality samples every 100 generations
+            # — the interior points ({100, 300}) are exactly where the
+            # adjudicated trajectory is budget-sensitive (0.199/0.080 @100)
+            # and where tools/bench_diff.py pins drift; sampling splits the
+            # scan at semantics-free boundaries, bit-identical results
+            record_quality=True,
+            quality_every=int(os.environ.get("BENCH_QUALITY_EVERY", 100)),
         )
         t0 = time.time()
         res = moeva.generate(x, minimize_class=1)
@@ -258,6 +267,14 @@ def run_real_botnet() -> dict | None:
             "steady_s": round(steady, 2),
             "cold_s": round(cold, 2),
             "o_rates_eps4": rates,
+            # engine-judged convergence curve + interior-point summary —
+            # the saturation-proof record: a survival-semantics regression
+            # moves the @100/@300 rates even when the full-budget o-rates
+            # stay all-ones (bench_diff gates on these)
+            "quality": quality_block(
+                res.quality,
+                final={"judged": "post_hoc_f64", "eps": 4.0, "o_rates": rates},
+            ),
         }
     except Exception as e:
         log(f"[bench] real-botnet metric skipped: {e}")
@@ -317,13 +334,17 @@ def run_early_exit_bench() -> dict | None:
         x = pool[np.argsort(np.abs(p1 - threshold))[:s]]
 
         from moeva2_ijcai22_replication_tpu.observability import (
-            Trace, TraceRecorder, get_ledger, telemetry_block, validate_record,
+            Trace, TraceRecorder, get_ledger, quality_block, telemetry_block,
+            validate_record,
         )
 
         moeva = Moeva2(
             classifier=sur, constraints=cons, ml_scaler=scaler, norm=2,
             n_gen=n_gen, n_pop=n_pop, n_offsprings=n_off, seed=42,
             archive_size=8, early_stop_threshold=threshold,
+            # quality samples ride the early-exit gates for free (the gate
+            # program computes them either way)
+            record_quality=True,
         )
         # gate progress events (gen index, success fraction, active set,
         # HBM) land in the record's telemetry block
@@ -384,7 +405,11 @@ def run_early_exit_bench() -> dict | None:
                 "gens_executed": int(early.gens_executed),
             },
             "telemetry": telemetry_block(
-                recorder=recorder, trace=moeva.trace, ledger_since=ledger_mark
+                recorder=recorder,
+                trace=moeva.trace,
+                ledger_since=ledger_mark,
+                # the early-exit run's quality curve (gate-cadence samples)
+                quality=quality_block(early.quality),
             ),
         }
         validate_record(record, "early_exit")
@@ -595,13 +620,21 @@ def main():
     moeva = Moeva2(
         classifier=sur, constraints=cons, ml_scaler=scaler,
         norm=2, n_gen=N_GEN, n_pop=N_POP, n_offsprings=N_OFF, seed=42,
+        # convergence telemetry for the headline record: interior samples
+        # every BENCH_QUALITY_EVERY generations (default 100 — the budgets
+        # bench_diff pins). Sampling splits the scan at semantics-free
+        # boundaries: results stay bit-identical, steady cost is a handful
+        # of tiny gate dispatches
+        record_quality=True,
+        quality_every=int(os.environ.get("BENCH_QUALITY_EVERY", 100)),
     )
     # unified tracing: engine progress events + HBM watermarks for the
     # record's telemetry block (host-side emission only — the measured
     # device programs are identical with or without it)
     from moeva2_ijcai22_replication_tpu.attacks.sharding import describe_mesh
     from moeva2_ijcai22_replication_tpu.observability import (
-        Trace, TraceRecorder, get_ledger, telemetry_block, validate_record,
+        Trace, TraceRecorder, get_ledger, quality_block, telemetry_block,
+        validate_record,
     )
 
     bench_recorder = TraceRecorder(spans_enabled=True)
@@ -625,7 +658,12 @@ def main():
         steady_runs.append(time.time() - t0)
     ours_s = min(steady_runs)
     headline_telemetry = telemetry_block(
-        recorder=bench_recorder, trace=moeva.trace, ledger_since=headline_mark
+        recorder=bench_recorder,
+        trace=moeva.trace,
+        ledger_since=headline_mark,
+        # the headline run's engine-judged convergence curve + interior
+        # summary — what bench_diff diffs across the committed series
+        quality=quality_block(res.quality),
     )
     log(f"[bench] ours: {ours_s:.1f}s steady / {cold_s:.1f}s cold "
         f"(compile-or-cache-load {cold_s - ours_s:.1f}s) for "

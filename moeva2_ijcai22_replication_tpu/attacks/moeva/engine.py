@@ -34,6 +34,8 @@ from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
 from ...observability import device_memory_stats
 from ...observability.ledger import LedgeredJit, get_ledger
+from ...observability.quality import merge_chunk_quality, sample_from_per_state
+from ..objective import engine_quality_stats
 from .initialisation import lp_ratio_init, tile_init
 from .operators import OperatorTables, make_operator_tables, make_offspring
 from .refdirs import energy_ref_dirs, rnsga3_geometry
@@ -67,6 +69,15 @@ class MoevaResult:
     #: shape, so a repack shows as a shrink) and the full early exit as
     #: ``active: 0``.
     early_stop: dict | None = None
+    #: convergence-quality history (None unless ``record_quality``):
+    #: ``{"gate_every", "threshold", "eps", "archive_size", "judged",
+    #: "samples": [...]}`` where each sample carries the per-gate
+    #: engine-space o1–o7 rates, best/mean constraint violation, best
+    #: distance (full precision — export rounding happens in
+    #: ``observability.quality``) plus the raw (S, 9) ``per_state`` stats
+    #: used for chunk merging. The last sample has ``final: True`` and is
+    #: computed host-side from the returned populations (pop ∪ archive).
+    quality: dict | None = None
 
 
 @dataclass
@@ -98,6 +109,12 @@ class _InFlightRun:
     hist_chunks: list
     pending: Any
     cp: Any
+    #: quality capture state (``record_quality``): the gate cadence that
+    #: actually ran, the last-known (S, 9) per-state stats at original row
+    #: indices (parked rows frozen at park time), and the recorded samples.
+    gate_every: int = 0
+    qual_latest: Any = None
+    qual_samples: list = None
 
 
 @dataclass
@@ -199,6 +216,30 @@ class Moeva2:
     #: (``experiments.common.DEFAULT_BUCKET_SIZES``). Sizes not divisible by
     #: the mesh size are skipped (states-axis sharding contract).
     compaction_buckets: tuple | None = None
+    #: record the convergence-quality history (``MoevaResult.quality``):
+    #: per-gate engine-space o1–o7 rates, best/mean constraint violation,
+    #: best distance, judged by the same criterion the early-exit gate uses
+    #: (``early_stop_threshold`` / ``early_stop_eps``). The gate program
+    #: computes the per-state stats unconditionally (a ~9-float reduction
+    #: riding the success-mask dispatch), so toggling this knob changes
+    #: which host-side fetches are *kept*, never the compiled programs, the
+    #: dispatch schedule, or the results — quality capture on/off is
+    #: bit-identical with zero extra compiles/dispatches (pinned by the
+    #: tier-1 smoke in ``tests/test_quality.py``). With no gates at all
+    #: (strict mode, ``quality_every`` 0) the history is the single final
+    #: sample, computed in numpy from the already-fetched populations.
+    record_quality: bool = False
+    #: gate cadence for quality sampling when early exit is OFF: split the
+    #: generation scan at every ``quality_every`` steps and sample quality
+    #: at each boundary. Segment chaining is bit-identical to one scan
+    #: (same RNG stream — keys split per generation inside the body), so
+    #: this changes results never, only the dispatch schedule (one extra
+    #: compiled segment length unless it divides ``n_gen - 1``, plus one
+    #: tiny gate dispatch per sample). Ignored when
+    #: ``early_stop_check_every`` is set — quality samples then ride the
+    #: early-exit gates. Prefer a value dividing the interior budgets the
+    #: watchdog pins ({100, 300}: 100, 50, 25 …).
+    quality_every: int = 0
     #: observability handle (``observability.Trace`` or None): a host-side
     #: dispatch knob like ``seed`` — NOT engine-cache key material, reset
     #: per grid point / serving batch by the callers. When set (and its
@@ -571,6 +612,9 @@ class Moeva2:
                 else [h[:n_real] for h in res.history],
                 gens_executed=res.gens_executed,
                 early_stop=res.early_stop,
+                # per-chunk quality keeps its padded per_state rows; the
+                # merge below trims them by each chunk's real row count
+                quality=res.quality,
             )
 
         for i, start in enumerate(range(0, s, chunk)):
@@ -619,6 +663,12 @@ class Moeva2:
             history=history,
             gens_executed=gens_executed,
             early_stop=early_stop,
+            # chunks share budget + gate cadence, so their per-gate samples
+            # concatenate along the states axis (aggregates recomputed)
+            quality=merge_chunk_quality(
+                [p.quality for p in parts],
+                [p.x_gen.shape[0] for p in parts],
+            ),
         )
 
     def _generate_one(
@@ -653,10 +703,15 @@ class Moeva2:
         return BucketMenu(sizes) if sizes else None
 
     def _success_mask(self, carry):
-        """(S,) on-device success mask from the carried objectives: the
-        ObjectiveCalculator criterion (misclassified ∧ Σ violations = 0 ∧
-        within ε) over population ∪ archive. A tiny program whose output is
-        the only device→host traffic between early-exit segments."""
+        """``(mask, stats)`` on-device gate outputs from the carried
+        objectives: the (S,) success mask — the ObjectiveCalculator
+        criterion (misclassified ∧ Σ violations = 0 ∧ within ε) over
+        population ∪ archive — plus the (S, 9) per-state quality stats
+        (``attacks.objective.QUALITY_STAT_COLUMNS``) judged by the same
+        criterion. One tiny program computes both unconditionally, so
+        quality capture on/off shares the same executable and dispatch
+        schedule; its outputs are the only device→host traffic between
+        gated segments, and the caller fetches only the leaves it needs."""
 
         if self._jit_success is None:
 
@@ -666,8 +721,10 @@ class Moeva2:
                     if arch_f.shape[1]
                     else pop_f
                 )
-                ok = (f[..., 0] < thr) & (f[..., 2] <= 0.0) & (f[..., 1] <= eps)
-                return ok.any(axis=1)
+                stats = engine_quality_stats(f, thr, eps, xp=jnp)
+                # o7 column: any misclassified ∧ feasible ∧ within-ε
+                # candidate — exactly the early-exit success criterion
+                return stats[..., 6] > 0, stats
 
             self._jit_success = LedgeredJit(
                 jax.jit(success_mask),
@@ -784,6 +841,11 @@ class Moeva2:
     ) -> _InFlightRun:
         s = x.shape[0]
         check = int(self.early_stop_check_every or 0)
+        qual_on = bool(self.record_quality)
+        # quality samples ride the early-exit gates when they exist;
+        # otherwise ``quality_every`` introduces its own (semantics-free)
+        # gate cadence. gate_every = 0 means no mid-run sync points.
+        gate_every = check or (int(self.quality_every or 0) if qual_on else 0)
         if check and self.save_history:
             raise ValueError(
                 "early_stop_check_every is incompatible with save_history: "
@@ -863,8 +925,8 @@ class Moeva2:
         # segment length so saves land exactly on ``checkpoint_every``
         # multiples.
         chunk = n_steps if not self.save_history else max(1, self.history_chunk)
-        if check:
-            chunk = max(1, min(chunk, check))
+        if gate_every:
+            chunk = max(1, min(chunk, gate_every))
         hist_chunks = []
         pending = None  # previous chunk's device buffer, fetched one dispatch late
         done = 0
@@ -876,6 +938,15 @@ class Moeva2:
         parked: dict | None = None
         trace: list = []
         gens_executed = 0
+        # quality capture state: last-known per-state stats at ORIGINAL row
+        # indices (the scatter below freezes parked rows at park time).
+        # NaN rows only exist before the first gate; the final sample in
+        # ``_finalize_one`` always covers every row from the returned
+        # populations. The history is observability, not semantics, so it
+        # is deliberately not checkpointed — a resumed run's curve starts
+        # at the resume point.
+        qual_samples: list = []
+        qual_latest = np.full((s, 9), np.nan) if qual_on else None
         if cp is not None:
             resumed = cp.load(carry)
             if resumed is not None:
@@ -905,11 +976,11 @@ class Moeva2:
         menu = self._compaction_menu() if check else None
         while done < n_steps:
             length = min(chunk, n_steps - done)
-            if check:
+            if gate_every:
                 # re-align on gate boundaries: a checkpoint cap below can
-                # shift ``done`` off the check multiples, and the gate must
-                # keep firing every ``check`` generations regardless
-                length = min(length, check - done % check)
+                # shift ``done`` off the gate multiples, and the gate must
+                # keep firing every ``gate_every`` generations regardless
+                length = min(length, gate_every - done % gate_every)
             if cp is not None:
                 length = min(
                     length, self.checkpoint_every - done % self.checkpoint_every
@@ -937,92 +1008,127 @@ class Moeva2:
                 # fetching the *previous* chunk overlaps with its compute
                 flush_pending()
                 pending = gen_hist
-            if check and done % check == 0 and done < n_steps:
-                succ = np.asarray(jax.device_get(self._success_mask(carry)))
-                solved = row_live & succ
-                n_parked = int(solved.sum())
-                if n_parked:
-                    # park: freeze the solved rows' returned populations on
-                    # host — success observed now can no longer be lost,
-                    # archive or not
-                    idx = np.where(solved)[0]
-                    if parked is None:
-                        cols = self.pop_size + self.archive_size
-                        parked = {
-                            "mask": np.zeros(s, dtype=bool),
-                            "x": np.zeros(
-                                (s, cols, self.codec.gen_length),
-                                dtype=np.dtype(self.dtype),
-                            ),
-                            "f": np.zeros(
-                                (s, cols, 3), dtype=np.dtype(self.dtype)
-                            ),
-                        }
-                    px, pf = jax.device_get(self._final_columns(carry, idx))
-                    parked["mask"][row_src[idx]] = True
-                    parked["x"][row_src[idx]] = px
-                    parked["f"][row_src[idx]] = pf
-                    row_live = row_live & ~succ
-                n_active = int(row_live.sum())
-                if n_active == 0:
-                    # every state holds a success: skip the remaining budget
-                    trace.append(
-                        {"gen": done, "active": 0, "bucket": len(row_src)}
+            if gate_every and done % gate_every == 0 and done < n_steps:
+                succ_dev, stats_dev = self._success_mask(carry)
+                if qual_on:
+                    # fetch the per-state stats leaf and scatter it home:
+                    # pads (row_live False) never overwrite a real row,
+                    # parked rows keep the stats frozen at park time
+                    stats = np.asarray(jax.device_get(stats_dev))
+                    qual_latest[row_src[row_live]] = stats[row_live]
+                    qual_samples.append(
+                        sample_from_per_state(done, qual_latest)
                     )
+                if not check:
+                    # quality-only gate (strict semantics, no early exit):
+                    # rounded progress event, full precision in the history
+                    sf = qual_samples[-1]["success_frac"]
+                    self._trace_event(
+                        "moeva.quality",
+                        gen=int(done),
+                        success_frac=None if sf is None else round(sf, 4),
+                    )
+                if check:
+                    succ = np.asarray(jax.device_get(succ_dev))
+                    solved = row_live & succ
+                    n_parked = int(solved.sum())
+                    if n_parked:
+                        # park: freeze the solved rows' returned populations
+                        # on host — success observed now can no longer be
+                        # lost, archive or not
+                        idx = np.where(solved)[0]
+                        if parked is None:
+                            cols = self.pop_size + self.archive_size
+                            parked = {
+                                "mask": np.zeros(s, dtype=bool),
+                                "x": np.zeros(
+                                    (s, cols, self.codec.gen_length),
+                                    dtype=np.dtype(self.dtype),
+                                ),
+                                "f": np.zeros(
+                                    (s, cols, 3), dtype=np.dtype(self.dtype)
+                                ),
+                            }
+                        px, pf = jax.device_get(
+                            self._final_columns(carry, idx)
+                        )
+                        parked["mask"][row_src[idx]] = True
+                        parked["x"][row_src[idx]] = px
+                        parked["f"][row_src[idx]] = pf
+                        row_live = row_live & ~succ
+                    n_active = int(row_live.sum())
+                    if n_active == 0:
+                        # every state holds a success: skip the rest of the
+                        # budget
+                        trace.append(
+                            {"gen": done, "active": 0, "bucket": len(row_src)}
+                        )
+                        self._trace_event(
+                            "moeva.gate",
+                            gen=int(done),
+                            active=0,
+                            parked=int(n_parked),
+                            success_frac=1.0,
+                            bucket=int(len(row_src)),
+                            early_exit=True,
+                        )
+                        break
+                    bucket = (
+                        menu.shrink_bucket(n_active, len(row_src))
+                        if menu
+                        else None
+                    )
+                    if bucket is not None:
+                        # compact: repack the unsolved active set down the
+                        # shared bucket menu (pads duplicate the last live
+                        # row; their results are never read back)
+                        keep = np.where(row_live)[0]
+                        sel = np.concatenate(
+                            [
+                                keep,
+                                np.full(bucket - n_active, keep[-1], keep.dtype),
+                            ]
+                        )
+                        carry = self._take_carry(carry, sel)
+                        row_src = row_src[sel]
+                        row_live = np.concatenate(
+                            [
+                                np.ones(n_active, dtype=bool),
+                                np.zeros(bucket - n_active, dtype=bool),
+                            ]
+                        )
+                        x_dev, mc_dev, xl_dev, xu_dev = self._place_rows(
+                            x, minimize_class, xl_ml, xu_ml, row_src
+                        )
+                        trace.append(
+                            {"gen": done, "active": n_active, "bucket": bucket}
+                        )
+                    elif n_parked:
+                        # states parked without a repack (no smaller menu
+                        # size): record the gate anyway — the trace must
+                        # account for every convergence, not only bucket
+                        # transitions
+                        trace.append(
+                            {
+                                "gen": done,
+                                "active": n_active,
+                                "bucket": len(row_src),
+                            }
+                        )
+                    # per-gate progress event: generation index, cumulative
+                    # success fraction, active set, and the (possibly just
+                    # shrunk) dispatch bucket — the between-gates visibility
+                    # the early-exit scan lacked. The payload rounds for
+                    # display; the recorded quality history keeps the full-
+                    # precision numbers.
                     self._trace_event(
                         "moeva.gate",
                         gen=int(done),
-                        active=0,
+                        active=n_active,
                         parked=int(n_parked),
-                        success_frac=1.0,
+                        success_frac=round(1.0 - n_active / s, 4),
                         bucket=int(len(row_src)),
-                        early_exit=True,
                     )
-                    break
-                bucket = (
-                    menu.shrink_bucket(n_active, len(row_src)) if menu else None
-                )
-                if bucket is not None:
-                    # compact: repack the unsolved active set down the shared
-                    # bucket menu (pads duplicate the last live row; their
-                    # results are never read back)
-                    keep = np.where(row_live)[0]
-                    sel = np.concatenate(
-                        [keep, np.full(bucket - n_active, keep[-1], keep.dtype)]
-                    )
-                    carry = self._take_carry(carry, sel)
-                    row_src = row_src[sel]
-                    row_live = np.concatenate(
-                        [
-                            np.ones(n_active, dtype=bool),
-                            np.zeros(bucket - n_active, dtype=bool),
-                        ]
-                    )
-                    x_dev, mc_dev, xl_dev, xu_dev = self._place_rows(
-                        x, minimize_class, xl_ml, xu_ml, row_src
-                    )
-                    trace.append(
-                        {"gen": done, "active": n_active, "bucket": bucket}
-                    )
-                elif n_parked:
-                    # states parked without a repack (no smaller menu size):
-                    # record the gate anyway — the trace must account for
-                    # every convergence, not only bucket transitions
-                    trace.append(
-                        {"gen": done, "active": n_active, "bucket": len(row_src)}
-                    )
-                # per-gate progress event: generation index, cumulative
-                # success fraction, active set, and the (possibly just
-                # shrunk) dispatch bucket — the between-gates visibility
-                # the early-exit scan lacked
-                self._trace_event(
-                    "moeva.gate",
-                    gen=int(done),
-                    active=n_active,
-                    parked=int(n_parked),
-                    success_frac=round(1.0 - n_active / s, 4),
-                    bucket=int(len(row_src)),
-                )
             if (
                 cp is not None
                 and done < n_steps
@@ -1055,6 +1161,9 @@ class Moeva2:
             hist_chunks=hist_chunks,
             pending=pending,
             cp=cp,
+            gate_every=gate_every,
+            qual_latest=qual_latest,
+            qual_samples=qual_samples,
         )
 
     def _finalize_one(self, run: _InFlightRun) -> MoevaResult:
@@ -1119,6 +1228,31 @@ class Moeva2:
                 "budget_gens": run.n_steps,
                 "compaction": run.trace,
             }
+        quality = None
+        if self.record_quality:
+            # final sample from the returned populations (pop ∪ archive,
+            # parked rows restored) — pure numpy on arrays already fetched
+            # above, so strict-mode quality costs zero device work
+            eps = float(self.early_stop_eps) / self._f2_scale
+            final_ps = engine_quality_stats(
+                np.asarray(pop_f, np.float64),
+                float(self.early_stop_threshold),
+                eps,
+                xp=np,
+            )
+            quality = {
+                "gate_every": run.gate_every,
+                "threshold": float(self.early_stop_threshold),
+                "eps": float(self.early_stop_eps),
+                "archive_size": int(self.archive_size),
+                "judged": "engine",
+                "samples": list(run.qual_samples or [])
+                + [
+                    sample_from_per_state(
+                        run.gens_executed, final_ps, final=True
+                    )
+                ],
+            }
         self._trace_event(
             "moeva.done",
             states=int(s),
@@ -1136,6 +1270,7 @@ class Moeva2:
             history=history,
             gens_executed=run.gens_executed,
             early_stop=early_stop,
+            quality=quality,
         )
 
     def _fingerprint(

@@ -43,6 +43,39 @@ from ..models.scalers import MinMaxParams
 
 O_COLUMNS = ("o1", "o2", "o3", "o4", "o5", "o6", "o7")
 
+#: column layout of :func:`engine_quality_stats` — the single source of
+#: truth for every consumer (engine gate program, host aggregation,
+#: serving gauges): the seven "state holds ≥1 qualifying candidate"
+#: booleans, then the state's best (minimum) summed constraint violation,
+#: then the best engine-objective distance among misclassified ∧ feasible
+#: candidates (+inf when the state has none yet).
+QUALITY_STAT_COLUMNS = O_COLUMNS + ("best_cv", "best_dist")
+
+
+def engine_quality_stats(f, threshold, eps, xp=jnp):
+    """Per-state convergence-quality statistics from *engine-space*
+    objective columns ``f`` (..., P, 3) = ``[f1, f2, g]`` (misclassification
+    probability, scaled Lp distance, summed violations — the MoEvA carry
+    layout). Returns (..., 9) per :data:`QUALITY_STAT_COLUMNS`.
+
+    The C/M/D semantics mirror :meth:`ObjectiveCalculator.respected`
+    (C = Σ violations ≤ 0, M = f1 < threshold, D = f2 ≤ eps) but are judged
+    on the engine's own objectives — per-state normalisation, engine dtype
+    — not the post-hoc f64 oracle judgement; consumers label the numbers
+    ``judged: "engine"`` accordingly. ``xp`` selects the backend: ``jnp``
+    inside the jitted gate program, ``np`` for host-side samples computed
+    from already-fetched arrays (zero extra device work) — one formula,
+    both sides, so curves and final samples can never drift apart.
+    """
+    c = f[..., 2] <= 0.0
+    m = f[..., 0] < threshold
+    d = f[..., 1] <= eps
+    cols = (c, m, d, c & m, c & d, m & d, c & m & d)
+    o = [col.any(axis=-1).astype(f.dtype) for col in cols]
+    best_cv = f[..., 2].min(axis=-1)
+    best_dist = xp.where(c & m, f[..., 1], xp.inf).min(axis=-1)
+    return xp.stack([*o, best_cv, best_dist], axis=-1)
+
 
 @dataclass
 class ObjectiveCalculator:

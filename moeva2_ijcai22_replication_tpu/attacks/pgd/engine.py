@@ -79,6 +79,17 @@ class ConstrainedPGD:
         )
         self._jit_attack = None
         self.loss_history: np.ndarray | None = None
+        #: per-restart quality history of the most recent ``generate``
+        #: (None without restarts): ``restart_success`` is the (R, N)
+        #: cumulative per-sample success mask after each restart (monotone
+        #: rows — the restart loop keeps first successes) and
+        #: ``restart_flip_frac`` its per-restart batch fraction. The mask
+        #: is per-row so a caller that padded the batch (runners pad to a
+        #: mesh multiple) can recompute unbiased fractions over its real
+        #: rows. Always computed inside the compiled program (the restart
+        #: loop already evaluates the success mask), so reading it costs
+        #: nothing extra.
+        self.quality_history: dict | None = None
         #: number of times the attack program was (re)traced — one trace per
         #: distinct executable. ε/ε-step are runtime arguments, so an ε sweep
         #: over a cached engine keeps this at 1 (grid observability reads it).
@@ -285,12 +296,13 @@ class ConstrainedPGD:
             # No restarts: return the attacked batch as-is (ART PGD semantics —
             # success filtering only arbitrates BETWEEN multiple restarts).
             if self.num_random_init == 0:
-                return self._one_run(
+                x_adv, hist = self._one_run(
                     params, x_init, y, x_init, eps, eps_step, max_iter
                 )
+                return x_adv, hist, jnp.zeros((0, x_init.shape[0]), bool)
 
             def restart(r, carry):
-                best_x, best_success, best_hist = carry
+                best_x, best_success, best_hist, succ_hist = carry
                 x_start = self._random_start(
                     jax.random.fold_in(key, r), x_init, eps
                 )
@@ -310,9 +322,15 @@ class ConstrainedPGD:
                     best_hist = jnp.where(upd[None, :, None], hist, best_hist)
                 else:
                     best_hist = hist
-                return best_x, best_success | success, best_hist
+                best_success = best_success | success
+                # per-restart quality history: the cumulative per-sample
+                # success mask after this restart (already computed for
+                # the keep/replace arbitration — recording it is free);
+                # per-row so padded batches can be trimmed by the caller
+                succ_hist = succ_hist.at[r].set(best_success)
+                return best_x, best_success, best_hist, succ_hist
 
-            best, _, hist = jax.lax.fori_loop(
+            best, _, hist, succ_hist = jax.lax.fori_loop(
                 0,
                 self.num_random_init,
                 restart,
@@ -320,9 +338,12 @@ class ConstrainedPGD:
                     x_init,
                     jnp.zeros(x_init.shape[0], bool),
                     self._hist_init(x_init.shape[0], x_init.dtype),
+                    jnp.zeros(
+                        (self.num_random_init, x_init.shape[0]), bool
+                    ),
                 ),
             )
-            return best, hist
+            return best, hist, succ_hist
 
         return attack
 
@@ -402,7 +423,7 @@ class ConstrainedPGD:
                 mi = repl_out[4]
             args = (params, x_dev, y_dev, key, eps_d, step_d)
         t0 = time.perf_counter()
-        out, hist = self._jit_attack(*args, mi)
+        out, hist, succ_curve = self._jit_attack(*args, mi)
         # (N, max_iter, C) — runners add the reference's unit axis on save
         # (01_pgd_united.py:196-199).
         self.loss_history = (
@@ -410,6 +431,14 @@ class ConstrainedPGD:
             if self.record_loss
             else None
         )
+        if self.num_random_init:
+            succ = np.asarray(jax.device_get(succ_curve), bool)
+            self.quality_history = {
+                "restart_success": succ,
+                "restart_flip_frac": succ.mean(axis=1).tolist(),
+            }
+        else:
+            self.quality_history = None
         x_out = np.asarray(jax.device_get(out))
         # roofline attribution: this fetch is the dispatch's sync point, so
         # dispatch->fetched wall-clock (compile excluded) is the run time of

@@ -27,10 +27,17 @@ import time
 import numpy as np
 
 from ..attacks.moeva import Moeva2
-from ..attacks.objective import ObjectiveCalculator
+from ..attacks.objective import O_COLUMNS, ObjectiveCalculator
 from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
-from ..observability import Trace, get_ledger, recorder_for, telemetry_block
+from ..observability import (
+    Trace,
+    get_ledger,
+    quality_block,
+    recorder_for,
+    telemetry_block,
+    trim_quality,
+)
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file, save_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
@@ -155,6 +162,12 @@ def run(config: dict, pipeline=None):
         # this cached engine may have pointed it at its own bucket menu
         buckets = config.get("compaction_buckets")
         moeva.compaction_buckets = tuple(buckets) if buckets else None
+        # convergence-quality capture: on by default (zero extra device
+        # work without gates — the final sample is numpy on fetched
+        # arrays); ``quality_every`` adds interior curve points by
+        # splitting the scan at a semantics-free cadence
+        moeva.record_quality = bool(config.get("record_quality", True))
+        moeva.quality_every = int(config.get("quality_every", 0) or 0)
         # per-point observability handle (reset like seed/n_gen: a cached
         # engine may carry the previous point's — or a serving batch's — trace)
         moeva.trace = trace
@@ -258,7 +271,10 @@ def run(config: dict, pipeline=None):
             "timings": timer.spans,
             "counters": timer.counters,
             # shared record schema: span totals, engine progress events,
-            # and the device-memory watermark travel with the number
+            # the device-memory watermark, and the convergence-quality
+            # curve travel with the number. ``final`` records the post-hoc
+            # f64 judgement (the last ε's o-rates) next to — never instead
+            # of — the engine-judged curve.
             "telemetry": telemetry_block(
                 timer=timer,
                 trace=trace,
@@ -266,6 +282,21 @@ def run(config: dict, pipeline=None):
                 if moeva.mesh is not None
                 else None,
                 ledger_since=ledger_mark,
+                quality=quality_block(
+                    # drop the mesh-pad duplicate rows (pad_states above)
+                    # exactly like x_attacks — padded rates would drift
+                    # with mesh size
+                    trim_quality(result.quality, n_orig),
+                    final={
+                        "judged": "post_hoc_f64",
+                        "eps": config["eps_list"][-1],
+                        "o_rates": [
+                            objective_lists[-1].get(k) for k in O_COLUMNS
+                        ],
+                    }
+                    if objective_lists
+                    else None,
+                ),
             ),
             "config": config,
             "config_hash": config_hash,

@@ -24,12 +24,18 @@ import time
 
 import numpy as np
 
-from ..attacks.objective import ObjectiveCalculator
+from ..attacks.objective import O_COLUMNS, ObjectiveCalculator
 from ..attacks.pgd import AutoPGD, ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sat import SatAttack
 from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
-from ..observability import Trace, get_ledger, recorder_for, telemetry_block
+from ..observability import (
+    Trace,
+    get_ledger,
+    quality_block,
+    recorder_for,
+    telemetry_block,
+)
 from ..utils.config import get_dict_hash, parse_config, save_config
 from ..utils.in_out import json_to_file
 from ..utils.observability import PhaseTimer, maybe_profile
@@ -154,6 +160,17 @@ def run(config: dict, pipeline=None):
         # re-dispatched for the next grid point while the writer thread is
         # still finalizing this one
         loss_history = attack.loss_history
+        # per-restart flip curve over the REAL rows only: the batch was
+        # padded to a mesh multiple above, and pad duplicates would bias
+        # the recorded fractions (the engine returns the per-row mask for
+        # exactly this trim)
+        restart_curve = None
+        if attack.quality_history is not None:
+            restart_curve = (
+                attack.quality_history["restart_success"][:, :n_orig]
+                .mean(axis=1)
+                .tolist()
+            )
         if loss_history is not None:
             loss_history = loss_history[:n_orig]
         hist_names = attack.hist_column_names()
@@ -256,7 +273,9 @@ def run(config: dict, pipeline=None):
             },
             "timings": timer.spans,
             "counters": timer.counters,
-            # shared record schema (observability.records)
+            # shared record schema (observability.records); quality = the
+            # post-hoc f64 o-rates as the final summary plus the engine's
+            # per-restart flip curve when restarts ran
             "telemetry": telemetry_block(
                 timer=timer,
                 trace=trace,
@@ -264,6 +283,15 @@ def run(config: dict, pipeline=None):
                 if attack.mesh is not None
                 else None,
                 ledger_since=ledger_mark,
+                quality=quality_block(
+                    final={
+                        "judged": "post_hoc_f64",
+                        "eps": config["eps"],
+                        "o_rates": [objectives.get(k) for k in O_COLUMNS],
+                    },
+                    restart_curve=restart_curve,
+                    judged="post_hoc_f64",
+                ),
             ),
             "config": config,
             "config_hash": config_hash,

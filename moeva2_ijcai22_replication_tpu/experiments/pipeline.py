@@ -41,6 +41,7 @@ import time
 from ..observability import (
     TraceRecorder,
     get_ledger,
+    quality_block,
     telemetry_block,
     validate_record,
 )
@@ -61,6 +62,11 @@ class GridPipeline:
         self._submitted = 0
         self.points: list[dict] = []
         self.write_failures: list[dict] = []
+        # per-point quality summaries harvested from the finalize closures'
+        # metrics (interior + final only — the full curves live in the
+        # per-point metrics JSONs); single writer thread appends, finish()
+        # reads after close(), so no lock is needed
+        self.point_quality: list[dict] = []
         # unified tracing recorder: the writer-queue depth gauge and grid
         # counters are always-on cheap instruments; with spans enabled
         # (``system.trace_log``) they also land in the event stream. The
@@ -86,8 +92,23 @@ class GridPipeline:
                     return
                 label, metrics_path, finalize = item
                 try:
-                    finalize()
+                    metrics = finalize()
                     self.recorder.count("grid_points_finalized")
+                    q = (
+                        (metrics.get("telemetry") or {}).get("quality")
+                        if isinstance(metrics, dict)
+                        else None
+                    )
+                    if isinstance(q, dict) and (
+                        q.get("interior") or q.get("final")
+                    ):
+                        self.point_quality.append(
+                            {
+                                "point": label,
+                                "interior": q.get("interior"),
+                                "final": q.get("final"),
+                            }
+                        )
                 except Exception as e:
                     logger.exception("grid point finalize failed: %s", label)
                     self.write_failures.append({"point": label, "error": repr(e)})
@@ -200,7 +221,14 @@ class GridPipeline:
                 ),
             },
             "telemetry": telemetry_block(
-                recorder=self.recorder, ledger_since=self._ledger_mark
+                recorder=self.recorder,
+                ledger_since=self._ledger_mark,
+                # grid-level quality: per-point interior/final summaries
+                # (the curves stay in the metrics JSONs they came from)
+                quality=dict(
+                    quality_block(judged="per_point"),
+                    points=self.point_quality,
+                ),
             ),
             "points": points,
         }

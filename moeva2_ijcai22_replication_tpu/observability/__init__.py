@@ -32,6 +32,16 @@ from .ledger import (
     get_ledger,
     ledger_context,
 )
+from .quality import (
+    DEFAULT_INTERIOR_BUDGETS,
+    QUALITY_KEYS,
+    interior_summary,
+    merge_chunk_quality,
+    quality_block,
+    sample_from_per_state,
+    trim_quality,
+    validate_quality,
+)
 from .records import (
     REQUIRED_RECORD_KEYS,
     build_identity,
@@ -50,7 +60,9 @@ from .trace import (
 )
 
 __all__ = [
+    "DEFAULT_INTERIOR_BUDGETS",
     "LEDGER",
+    "QUALITY_KEYS",
     "REQUIRED_RECORD_KEYS",
     "CostLedger",
     "LedgerEntry",
@@ -63,10 +75,16 @@ __all__ = [
     "default_recorder",
     "device_memory_stats",
     "get_ledger",
+    "interior_summary",
     "ledger_context",
     "maybe_span",
+    "merge_chunk_quality",
+    "quality_block",
     "recorder_for",
+    "sample_from_per_state",
     "telemetry_block",
+    "trim_quality",
     "use_trace",
+    "validate_quality",
     "validate_record",
 ]

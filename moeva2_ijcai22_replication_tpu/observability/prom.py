@@ -36,6 +36,50 @@ def _escape_label(v) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+#: HELP texts for the structured metric families; everything else gets a
+#: generated line naming its snapshot section — real scrapers (and the
+#: promtool linter) expect every family to carry # HELP and # TYPE.
+_HELP = {
+    "cost_ledger_executables": "Distinct compiled executables observed by the cost ledger",
+    "cost_ledger_compile_s_total": "Total XLA compile wall-clock seconds across all executables",
+    "cost_ledger_dispatches": "Total dispatches through ledgered executables",
+    "cost_ledger_cache_hits": "AOT executable-cache hits",
+    "cost_ledger_cache_misses": "AOT executable-cache misses (compiles)",
+    "executable_compile_s": "Per-executable XLA compile wall-clock seconds",
+    "executable_flops": "Per-executable model FLOPs per dispatch (XLA cost analysis)",
+    "executable_bytes_accessed": "Per-executable bytes accessed per dispatch (XLA cost analysis)",
+    "executable_dispatches": "Per-executable dispatch count",
+    "executable_run_s": "Per-executable attributed run seconds",
+    "executable_achieved_flops_s": "Per-executable achieved FLOP/s (roofline)",
+    "executable_bytes_s": "Per-executable achieved bytes/s (roofline)",
+    "executable_arithmetic_intensity": "Per-executable arithmetic intensity (FLOPs per byte)",
+    "quality_o_rate": "Engine-judged attack success rate per objective column (last MoEvA batch)",
+    "quality_best_cv": "Best (minimum) summed constraint violation in the last MoEvA batch",
+    "quality_mean_cv": "Mean per-state best constraint violation in the last MoEvA batch",
+    "quality_best_dist": "Best engine-objective distance among successful candidates",
+    "quality_batches": "MoEvA batches that contributed quality samples",
+    "quality_gen": "Generation steps executed by the last sampled MoEvA batch",
+}
+
+
+def _family(
+    lines: list[str], name: str, mtype: str, key: str = "", help_text: str | None = None
+):
+    """One # HELP + # TYPE header pair per metric family. ``key`` is the
+    un-prefixed snapshot name used to look up a curated HELP text; unknown
+    families get a generated one — every family MUST carry both lines so
+    real scrapers (and promtool) ingest the exposition cleanly."""
+    text = help_text or _HELP.get(
+        key, f"{key or name} ({mtype} from the moeva2 metrics snapshot)"
+    )
+    lines.append(f"# HELP {name} {_escape_help(text)}")
+    lines.append(f"# TYPE {name} {mtype}")
+
+
 def _ledger_lines(prefix: str, block: dict, lines: list[str]) -> None:
     """Cost-ledger exposition: summary scalars as gauges plus one labeled
     gauge family per per-executable measure — ``{executable, producer}``
@@ -51,7 +95,7 @@ def _ledger_lines(prefix: str, block: dict, lines: list[str]) -> None:
         v = block.get(key)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             n = _name(prefix, f"cost_ledger_{key}")
-            lines.append(f"# TYPE {n} gauge")
+            _family(lines, n, "gauge", f"cost_ledger_{key}")
             lines.append(f"{n} {_fmt(v)}")
     entries = block.get("entries") or []
     for field in (
@@ -73,13 +117,57 @@ def _ledger_lines(prefix: str, block: dict, lines: list[str]) -> None:
         if not rows:
             continue
         n = _name(prefix, f"executable_{field}")
-        lines.append(f"# TYPE {n} gauge")
+        _family(lines, n, "gauge", f"executable_{field}")
         for e, v in rows:
             labels = (
                 f'executable="{_escape_label(e.get("key"))}",'
                 f'producer="{_escape_label(e.get("producer"))}"'
             )
             lines.append(f"{n}{{{labels}}} {_fmt(v)}")
+
+
+def _quality_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Per-domain attack-quality exposition: one labeled gauge family per
+    measure — ``{domain}`` (and ``{domain, objective}`` for the o-rate
+    family) labels so a dashboard can plot served success rates per domain
+    next to the latency and cost families."""
+    by_domain = block.get("by_domain") or {}
+    if not by_domain:
+        return
+    o_rows, scalar_rows = [], {k: [] for k in ("best_cv", "mean_cv", "best_dist", "gen")}
+    batch_rows = []
+    for domain, q in sorted(by_domain.items()):
+        last = q.get("last") or {}
+        batch_rows.append((domain, q.get("batches")))
+        for i, v in enumerate(last.get("o_rates") or []):
+            o_rows.append((domain, f"o{i + 1}", v))
+        for k in scalar_rows:
+            v = last.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                scalar_rows[k].append((domain, v))
+    if o_rows:
+        n = _name(prefix, "quality_o_rate")
+        _family(lines, n, "gauge", "quality_o_rate")
+        for domain, obj, v in o_rows:
+            lines.append(
+                f'{n}{{domain="{_escape_label(domain)}",'
+                f'objective="{obj}"}} {_fmt(v)}'
+            )
+    for k, rows in scalar_rows.items():
+        if not rows:
+            continue
+        n = _name(prefix, f"quality_{k}")
+        _family(lines, n, "gauge", f"quality_{k}")
+        for domain, v in rows:
+            lines.append(f'{n}{{domain="{_escape_label(domain)}"}} {_fmt(v)}')
+    if any(isinstance(v, int) for _, v in batch_rows):
+        n = _name(prefix, "quality_batches")
+        _family(lines, n, "gauge", "quality_batches")
+        for domain, v in batch_rows:
+            if isinstance(v, int):
+                lines.append(
+                    f'{n}{{domain="{_escape_label(domain)}"}} {_fmt(v)}'
+                )
 
 
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
@@ -89,20 +177,23 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     ledger_block = snapshot.get("cost_ledger")
     if isinstance(ledger_block, dict):
         _ledger_lines(prefix, ledger_block, lines)
+    quality_block = snapshot.get("quality")
+    if isinstance(quality_block, dict):
+        _quality_lines(prefix, quality_block, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
-        lines.append(f"# TYPE {n} counter")
+        _family(lines, n, "counter", name)
         lines.append(f"{n} {_fmt(v)}")
 
     for name, v in sorted(snapshot.get("gauges", {}).items()):
         n = _name(prefix, name)
-        lines.append(f"# TYPE {n} gauge")
+        _family(lines, n, "gauge", name)
         lines.append(f"{n} {_fmt(v)}")
 
     for name, s in sorted(snapshot.get("streams", {}).items()):
         n = _name(prefix, name)
-        lines.append(f"# TYPE {n} summary")
+        _family(lines, n, "summary", name)
         for q, key in (("0.5", "p50"), ("0.99", "p99")):
             v = s.get(key)
             if v is not None and not (isinstance(v, float) and math.isnan(v)):
@@ -118,17 +209,17 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     # gauges, one-level dicts of numbers (cache stats) become one gauge per
     # sub-key — so engine/artifact cache health is scrapeable too
     for key, v in sorted(snapshot.items()):
-        if key in ("counters", "gauges", "streams", "cost_ledger"):
+        if key in ("counters", "gauges", "streams", "cost_ledger", "quality"):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             n = _name(prefix, key)
-            lines.append(f"# TYPE {n} gauge")
+            _family(lines, n, "gauge", key)
             lines.append(f"{n} {_fmt(v)}")
         elif isinstance(v, dict):
             for sub, sv in sorted(v.items()):
                 if isinstance(sv, (int, float)) and not isinstance(sv, bool):
                     n = _name(prefix, f"{key}_{sub}")
-                    lines.append(f"# TYPE {n} gauge")
+                    _family(lines, n, "gauge", f"{key}_{sub}")
                     lines.append(f"{n} {_fmt(sv)}")
 
     return "\n".join(lines) + "\n"

@@ -1,0 +1,252 @@
+"""Attack-quality telemetry: convergence curves and interior-point summaries.
+
+PR 4/5 made the framework observable in *time* and *FLOPs*; this module is
+the *quality* axis — the convergence trajectory of an attack as first-class
+telemetry. The MoEvA engine (and the PGD restart loop) record per-gate
+per-state statistics (``attacks.objective.QUALITY_STAT_COLUMNS``); this
+module aggregates them into JSON-ready samples, merges samples across
+state chunks, and assembles the ``telemetry.quality`` block every
+bench/grid/serving/runner record must carry
+(``records.validate_record``). The block's load-bearing part is the
+``interior`` summary: success rates pinned at interior budgets
+(default {100, 300} generation steps, plus ``full``), exactly where a
+survival-semantics regression moves the numbers while a saturated
+full-budget record stays all-ones — ``tools/bench_diff.py`` diffs these
+across the committed ``BENCH_r*.json`` series and fails tier-1 on drift.
+
+Rounding contract: per-state stats and the per-sample ``success_frac`` /
+``o_rates`` in the *recorded history* keep full float precision (drift
+thresholds are ~0.1; stacking a 1e-4 rounding per hop is avoidable noise);
+rounding to display precision happens only here, at export time, via
+``round_digits`` — the same rule the engine's trace events follow
+(rounded payloads for humans, full precision in the history).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: interior budgets (generation steps) the exported summary pins by
+#: default — the adjudicated botnet trajectory's interior points
+#: (0.199/0.080 @100 → 0.959/0.910 @300 → saturated @1000, DESIGN §9).
+DEFAULT_INTERIOR_BUDGETS = (100, 300)
+
+#: keys every ``telemetry.quality`` block must carry (validate_record).
+QUALITY_KEYS = ("judged", "samples", "curve", "interior")
+
+
+def sample_from_per_state(gen: int, per_state, **extra) -> dict:
+    """One quality sample from a (S, 9) per-state stats array
+    (``attacks.objective.QUALITY_STAT_COLUMNS``): o1–o7 rates (fraction of
+    states holding ≥1 qualifying candidate), best/mean constraint
+    violation, best distance — full precision, with the raw per-state
+    array kept under ``per_state`` for chunk merging (stripped at export
+    by :func:`quality_block`)."""
+    # copy, not view: the engine keeps mutating its ``qual_latest`` buffer
+    # after the sample is taken
+    ps = np.array(per_state, np.float64)
+    # NaN rows = states with no stats yet at this gate (only possible on a
+    # checkpoint-resumed compacted run before its first full gate): the
+    # aggregates exclude them — NaN would both bias the rates and poison
+    # the strict-JSON export
+    known = ~np.isnan(ps[:, 0])
+    kp = ps[known] if known.any() else np.zeros((0, ps.shape[1]))
+    bd = kp[:, 8] if len(kp) else np.zeros(0)
+    finite = np.isfinite(bd)
+
+    def _f(v):
+        return float(v) if len(kp) else None
+
+    return {
+        "gen": int(gen),
+        "o_rates": [_f(v) for v in kp[:, :7].mean(axis=0)]
+        if len(kp)
+        else [None] * 7,
+        # success_frac = the o7 rate under the engine criterion; kept as
+        # its own key (full precision) because it is the number the gate
+        # events round for display
+        "success_frac": _f(kp[:, 6].mean()) if len(kp) else None,
+        "best_cv": _f(kp[:, 7].min()) if len(kp) else None,
+        "mean_cv": _f(kp[:, 7].mean()) if len(kp) else None,
+        "best_dist": float(bd[finite].min()) if finite.any() else None,
+        "mean_best_dist": float(bd[finite].mean()) if finite.any() else None,
+        "states_known": int(known.sum()),
+        "per_state": ps,
+        **extra,
+    }
+
+
+def merge_chunk_quality(parts: list[dict | None], n_reals: list[int]) -> dict | None:
+    """Merge per-chunk engine quality histories (sequential
+    ``max_states_per_call`` chunks of one attack) into one history over the
+    full states axis: per-state rows are concatenated per gate (chunks
+    share the budget and gate cadence) and the aggregates recomputed. A
+    chunk that early-exited stops sampling; its last known per-state stats
+    carry forward (its states are all solved — that is why it exited)."""
+    if not parts or parts[0] is None:
+        return None
+    # per chunk: gen -> per_state (trimmed to the chunk's real rows)
+    per_chunk: list[dict[int, np.ndarray]] = []
+    finals: list[np.ndarray] = []
+    gens: set[int] = set()
+    for part, n_real in zip(parts, n_reals):
+        by_gen: dict[int, np.ndarray] = {}
+        final = None
+        for s in part["samples"]:
+            ps = np.asarray(s["per_state"])[:n_real]
+            if s.get("final"):
+                final = ps
+            else:
+                by_gen[s["gen"]] = ps
+                gens.add(s["gen"])
+        per_chunk.append(by_gen)
+        finals.append(final)
+    samples = []
+    last: list[np.ndarray | None] = [None] * len(per_chunk)
+    for g in sorted(gens):
+        rows = []
+        for i, by_gen in enumerate(per_chunk):
+            ps = by_gen.get(g)
+            if ps is None:  # early-exited chunk: carry its last stats
+                ps = last[i] if last[i] is not None else finals[i]
+            else:
+                last[i] = ps
+            if ps is not None:
+                rows.append(ps)
+        if rows:
+            samples.append(sample_from_per_state(g, np.concatenate(rows, axis=0)))
+    if all(f is not None for f in finals):
+        gen_final = max(p["samples"][-1]["gen"] for p in parts)
+        samples.append(
+            sample_from_per_state(
+                gen_final, np.concatenate(finals, axis=0), final=True
+            )
+        )
+    # header (gate cadence / thresholds / judged) comes from chunk 0 —
+    # chunks run one attack's config, so the headers are identical
+    return dict(parts[0], samples=samples)
+
+
+def trim_quality(quality: dict | None, n_real: int) -> dict | None:
+    """Drop trailing pad rows from an engine quality history and recompute
+    every aggregate. The runners pad the states axis to a mesh multiple
+    before ``generate`` (pads duplicate real rows), then trim the attack
+    outputs back to ``n_real`` — the recorded rates must be trimmed the
+    same way or every mesh run's o-rates count its last state multiple
+    times (mesh-dependent drift in exactly the numbers the watchdog gates
+    on)."""
+    if quality is None:
+        return None
+    out = dict(quality)
+    out["samples"] = [
+        sample_from_per_state(
+            s["gen"],
+            np.asarray(s["per_state"])[:n_real],
+            **{k: s[k] for k in ("final",) if k in s},
+        )
+        for s in quality["samples"]
+    ]
+    return out
+
+
+def round_digits(value, digits: int = 4):
+    """Display rounding for exported payloads (events, JSON curves)."""
+    if isinstance(value, float):
+        return round(value, digits)
+    if isinstance(value, (list, tuple)):
+        return [round_digits(v, digits) for v in value]
+    return value
+
+
+def _export_sample(sample: dict, digits: int | None) -> dict:
+    out = {k: v for k, v in sample.items() if k != "per_state"}
+    if digits is not None:
+        out = {k: round_digits(v, digits) for k, v in out.items()}
+    return out
+
+
+def interior_summary(
+    samples: list[dict], budgets=DEFAULT_INTERIOR_BUDGETS, digits: int | None = 6
+) -> dict:
+    """Pin the curve at interior budgets: for each budget, the latest
+    sample at ``gen <= budget`` (exact when the gate cadence divides the
+    budget) — but only when the trajectory actually REACHED the budget
+    (a 40-generation run has no "@100" point; labeling its final state as
+    one would make cross-record diffs compare different budgets); ``full``
+    = the last sample. Budgets with no valid sample are omitted rather
+    than nulled — their absence in a diff reads as "not comparable",
+    never "regressed to nothing"."""
+    out: dict = {}
+    horizon = max((s["gen"] for s in samples), default=-1)
+    for budget in budgets:
+        if horizon < budget:
+            continue
+        eligible = [s for s in samples if s["gen"] <= budget and not s.get("final")]
+        if eligible:
+            out[str(int(budget))] = _export_sample(eligible[-1], digits)
+    if samples:
+        out["full"] = _export_sample(samples[-1], digits)
+    return out
+
+
+def quality_block(
+    engine_quality: dict | None = None,
+    *,
+    budgets=DEFAULT_INTERIOR_BUDGETS,
+    final: dict | None = None,
+    restart_curve=None,
+    judged: str | None = None,
+    digits: int | None = 6,
+) -> dict:
+    """Assemble the JSON-ready ``telemetry.quality`` block.
+
+    ``engine_quality`` is a ``MoevaResult.quality`` dict (per-gate samples
+    with per-state arrays); ``restart_curve`` a PGD engine's per-restart
+    history; ``final`` an externally judged final summary (e.g. the
+    runner's post-hoc f64 o-rates) recorded next to — never instead of —
+    the engine curve. With no inputs the block is empty but schema-valid
+    (``samples: 0``), so every record producer can carry the key
+    unconditionally."""
+    block: dict = {
+        "judged": judged
+        or (engine_quality or {}).get("judged")
+        or ("engine" if engine_quality else None),
+        "samples": 0,
+        "curve": [],
+        "interior": {},
+    }
+    if engine_quality:
+        samples = engine_quality.get("samples") or []
+        block["samples"] = len(samples)
+        block["curve"] = [_export_sample(s, digits) for s in samples]
+        block["interior"] = interior_summary(samples, budgets, digits)
+        for k in ("gate_every", "threshold", "eps", "archive_size"):
+            if k in engine_quality:
+                v = engine_quality[k]
+                # inf thresholds are strict-JSON poison (RFC 8259): null
+                block[k] = None if isinstance(v, float) and not np.isfinite(v) else v
+    if restart_curve is not None:
+        block["restart_curve"] = round_digits(
+            [float(v) for v in np.asarray(restart_curve, np.float64)], digits
+        )
+    if final is not None:
+        block["final"] = final
+    return block
+
+
+def validate_quality(block, kind: str = "record") -> dict:
+    """Assert ``block`` is a schema-valid quality block; returns it."""
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} record's telemetry.quality must be a dict, got "
+            f"{type(block).__name__}"
+        )
+    missing = [k for k in QUALITY_KEYS if k not in block]
+    if missing:
+        raise ValueError(
+            f"{kind} record's telemetry.quality is missing keys {missing}: "
+            "assemble it with observability.quality.quality_block so the "
+            "convergence curve and interior-point summary travel with "
+            "every committed number"
+        )
+    return block

@@ -13,6 +13,7 @@ asserts the producers keep calling it).
 from __future__ import annotations
 
 from .ledger import get_ledger
+from .quality import quality_block, validate_quality
 from .trace import Trace, TraceRecorder, device_memory_stats
 
 #: the keys every bench / grid-report / serving-sweep record must carry.
@@ -27,6 +28,7 @@ def telemetry_block(
     device=None,
     ledger=None,
     ledger_since: dict | None = None,
+    quality: dict | None = None,
 ) -> dict:
     """JSON-ready telemetry summary for a record: span totals (from a
     PhaseTimer), trace id + event count (from a Trace), recorder counters,
@@ -36,8 +38,16 @@ def telemetry_block(
     ``ledger_since`` (a ``CostLedger.mark()`` taken at run start) so the
     record's ``cost`` block covers *this run's* executables, not the
     process lifetime — on a shared-engine grid the difference is every
-    warm point otherwise re-reporting the first point's compiles."""
+    warm point otherwise re-reporting the first point's compiles.
+
+    ``quality`` is a pre-assembled ``observability.quality.quality_block``
+    (convergence curve + interior-point summary); omitted, an empty but
+    schema-valid block is inserted so every producer satisfies the
+    ``telemetry.quality`` schema unconditionally."""
     block: dict = {"hbm": device_memory_stats(device)}
+    block["quality"] = validate_quality(
+        quality if quality is not None else quality_block()
+    )
     if timer is not None:
         block["spans_s"] = {k: round(v, 4) for k, v in timer.spans.items()}
         block["span_total_s"] = round(sum(timer.spans.values()), 4)
@@ -70,6 +80,15 @@ def validate_record(record: dict, kind: str = "record") -> dict:
             "telemetry_block so the executable cost ledger travels with "
             "every committed number"
         )
+    if "quality" not in telemetry:
+        raise ValueError(
+            f"{kind} record's telemetry block is missing the 'quality' "
+            "sub-block: assemble it with observability.records."
+            "telemetry_block (optionally passing quality_block(...)) so "
+            "the convergence curve / interior-point summary travels with "
+            "every committed number"
+        )
+    validate_quality(telemetry["quality"], kind)
     return record
 
 

@@ -37,6 +37,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..attacks.objective import engine_quality_stats
 from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sharding import describe_mesh
 from ..experiments import common
@@ -48,6 +49,7 @@ from ..observability import (
     device_memory_stats,
     get_ledger,
     maybe_span,
+    sample_from_per_state,
 )
 from ..utils.config import get_dict_hash
 from ..utils.observability import ServiceMetrics
@@ -184,6 +186,10 @@ class AttackService:
             start=start,
         )
         self._resolved: dict[tuple, _Resolved] = {}
+        # per-domain attack-quality aggregation (MoEvA dispatches): last
+        # engine-judged sample + a dispatch count, computed host-side from
+        # the already-fetched result objectives — zero device work
+        self._quality: dict[str, dict] = {}
         self._lock = threading.Lock()
         # misses resolve under one lock: the process-wide ENGINES/ARTIFACTS
         # caches are grid-runner substrate (single-threaded there) and not
@@ -332,6 +338,7 @@ class AttackService:
             early_stop = int(pseudo.get("early_stop_check_every", 0) or 0)
             es_threshold = float(pseudo.get("early_stop_threshold", 0.5))
             es_eps = float(pseudo.get("early_stop_eps", np.inf))
+            domain_name = req.domain
 
             def dispatch(x_batch: np.ndarray) -> np.ndarray:
                 bt = current_trace()
@@ -344,6 +351,11 @@ class AttackService:
                 engine.early_stop_threshold = es_threshold
                 engine.early_stop_eps = es_eps
                 engine.compaction_buckets = self.menu.sizes
+                # a batch runner sharing this cached engine may have left
+                # its quality capture on; the serving path computes its
+                # sample host-side from result.f instead (below)
+                engine.record_quality = False
+                engine.quality_every = 0
                 # the engine's gate progress events (generation index,
                 # success fraction, active set, HBM) land in the batch trace
                 engine.trace = bt
@@ -357,6 +369,20 @@ class AttackService:
                     bt, engine, traces0, t0,
                     gens_executed=int(result.gens_executed),
                 )
+                # batch quality: engine-judged o-rates/violations over the
+                # (bucket-padded) batch from the fetched objectives — numpy
+                # only; lands in the per-domain gauges, /healthz, /metrics,
+                # and (via the batch trace) every rider's meta.trace
+                sample = sample_from_per_state(
+                    int(result.gens_executed),
+                    engine_quality_stats(
+                        np.asarray(result.f, np.float64),
+                        es_threshold,
+                        es_eps / getattr(engine, "_f2_scale", 1.0),
+                        xp=np,
+                    ),
+                )
+                self._note_quality(domain_name, sample, bt)
                 with maybe_span(bt, "decode"):
                     return np.asarray(result.x_ml)
 
@@ -514,6 +540,42 @@ class AttackService:
             x_run, n_orig = common.pad_states(x, res.mesh)
         return np.asarray(res.dispatch(x_run))[:n_orig]
 
+    def _note_quality(self, domain: str, sample: dict, bt=None) -> None:
+        """Fold one batch's engine-judged quality sample into the per-domain
+        aggregation: gauges (scrapeable), the structured ``quality``
+        snapshot section (labeled Prometheus gauges + /healthz), and — when
+        the batch is traced — a ``quality`` event every riding request's
+        ``meta.trace`` carries. Payloads round for display; the stored
+        sample keeps full precision."""
+        stored = {k: v for k, v in sample.items() if k != "per_state"}
+        with self._lock:
+            prev = self._quality.get(domain)
+            self._quality[domain] = {
+                "batches": (prev["batches"] if prev else 0) + 1,
+                "last": stored,
+            }
+        self.metrics.gauge(
+            f"quality_success_frac_{domain}", sample["success_frac"]
+        )
+        if bt is not None:
+            bt.event(
+                "quality",
+                o7_rate=round(sample["success_frac"], 4),
+                best_cv=round(sample["best_cv"], 6),
+                gen=sample["gen"],
+            )
+
+    def quality_snapshot(self) -> dict:
+        """Structured per-domain quality state: the last engine-judged
+        sample per domain plus how many MoEvA batches contributed."""
+        with self._lock:
+            return {
+                "by_domain": {
+                    k: {"batches": v["batches"], "last": dict(v["last"])}
+                    for k, v in self._quality.items()
+                }
+            }
+
     # -- introspection -------------------------------------------------------
     def healthz(self) -> dict:
         # mesh identity per domain: the configured device count always, plus
@@ -549,6 +611,10 @@ class AttackService:
             # a replica that recompiles on every request shows up here
             # before it shows up in latency
             "ledger": get_ledger().summary(),
+            # attack-quality summary: the last engine-judged o-rates per
+            # domain — a replica whose served success rates drifted shows
+            # up here before a caller complains
+            "quality": self.quality_snapshot(),
             "caches": {
                 "engine": dict(
                     common.ENGINES.stats(),
@@ -579,6 +645,9 @@ class AttackService:
         # per-executable identity + cost + roofline: JSON here, labeled
         # gauges under /metrics?format=prom (observability.prom)
         snap["cost_ledger"] = get_ledger().cost_block()
+        # per-domain attack quality: JSON here, labeled
+        # moeva2_quality_o_rate{domain,objective} gauges under prom
+        snap["quality"] = self.quality_snapshot()
         return snap
 
     def close(self):

@@ -20,7 +20,12 @@ from __future__ import annotations
 import time
 from typing import Callable, Sequence
 
-from ..observability import get_ledger, telemetry_block, validate_record
+from ..observability import (
+    get_ledger,
+    quality_block,
+    telemetry_block,
+    validate_record,
+)
 from ..utils.observability import percentile
 from .batcher import DeadlineExceeded, QueueFull, RequestTooLarge
 from .service import AttackRequest, AttackService
@@ -149,8 +154,16 @@ def offered_load_sweep(
                 "max_delay_s": service.batcher.max_delay_s,
                 "resolved_run_configs": snap["resolved_run_configs"],
             },
+            # quality: the per-domain engine-judged aggregation the service
+            # collected over the sweep's MoEvA batches (empty for a pure
+            # PGD sweep — PGD quality lives in the runners' post-hoc rates)
             "telemetry": telemetry_block(
-                recorder=service.recorder, ledger_since=ledger_mark
+                recorder=service.recorder,
+                ledger_since=ledger_mark,
+                quality=dict(
+                    quality_block(judged="engine"),
+                    **service.quality_snapshot(),
+                ),
             ),
         },
         "serving",

@@ -1,0 +1,235 @@
+"""End-to-end oracle GA: the engine's generation loop with every survival
+round replayed through the vendored pymoo R-NSGA-III oracle.
+
+VERDICT r5 named the one remaining epistemic gap: the survival oracle
+(``pymoo_rnsga3.py``) validated single rounds, never a *trajectory*, so the
+interior budget-100 success rates (exactly where the pre/post-fix kernels
+diverged 4.5x) had no reference-side counterpart. This module closes it:
+:func:`run_oracle_ga` replays the engine's per-generation loop eagerly —
+same key schedule, same operator/evaluation kernels, same
+``survive_batch`` — and, at every generation, re-derives the survivor set
+through ``oracle.aspiration_survive`` in shared-trace mode (both sides
+consume the same two gumbel fields, so the comparison is exact,
+index-for-index, through the random niching paths). A trajectory with zero
+mismatches means every survival decision of the run was pymoo-semantics
+verified, and its final-population success rates are therefore
+*oracle-validated interior rates* — what ``tools/oracle_check.py`` commits
+as fixtures and ``tools/bench_diff.py`` then guards.
+
+Precision: the loop runs in float64 (pass a ``dtype=jnp.float64`` engine)
+so the kernel and the f64 oracle judge identical values — the exact-match
+regime the shared-trace fuzz (``test_survival_pymoo_diff.py``) pins. The
+production engine runs f32; its rates are compared to the oracle GA's
+within seed-noise bands, never bit-for-bit (the trajectories decohere
+chaotically, the *distribution* is the claim).
+
+Test-only code, like the oracle it drives: never imported by the package.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import survival as sv
+from moeva2_ijcai22_replication_tpu.attacks.moeva.initialisation import tile_init
+from moeva2_ijcai22_replication_tpu.attacks.moeva.operators import make_offspring
+from moeva2_ijcai22_replication_tpu.core import codec as codec_lib
+
+from . import pymoo_rnsga3 as oracle
+
+N_OBJ = 3
+#: Das-Dennis cluster for pop_per_ref_point=1 (the reference's RNSGA3
+#: construction — one direction at the simplex centroid per aspiration).
+K1 = np.full((1, N_OBJ), 1.0 / N_OBJ)
+
+
+def _clone(state: oracle.OracleNormState) -> oracle.OracleNormState:
+    st = oracle.OracleNormState(N_OBJ)
+    st.ideal_point = state.ideal_point.copy()
+    st.worst_point = state.worst_point.copy()
+    st.extreme_points = (
+        None if state.extreme_points is None else state.extreme_points.copy()
+    )
+    return st
+
+
+def _oracle_survive_pinned(f, asp, n_survive, state, gum_cut, gum_mem):
+    """One oracle survival round with the solver pinned the way the diff
+    test pins it: LAPACK nadir by default, the kernel's Cramer formulation
+    inside the ill-conditioned band (1e9 < cond < 1e15) where the two
+    solvers legitimately diverge at tolerance boundaries. Runs on clones
+    and commits the chosen run's mutated state; returns
+    (survivor_indices, committed_state)."""
+    st = _clone(state)
+    idx, dbg = oracle.aspiration_survive(
+        f, asp, K1, n_survive, st, np.random.RandomState(0),
+        niche_priority=gum_cut, member_priority=gum_mem,
+    )
+    cond = np.linalg.cond(dbg["extreme"] - dbg["ideal"])
+    if 1e9 < cond < 1e15:
+        st = _clone(state)
+        idx, dbg = oracle.aspiration_survive(
+            f, asp, K1, n_survive, st, np.random.RandomState(0),
+            nadir_solver="cramer",
+            niche_priority=gum_cut, member_priority=gum_mem,
+        )
+    return idx, st
+
+
+def run_oracle_ga(
+    moeva,
+    x: np.ndarray,
+    minimize_class: int = 1,
+    *,
+    check_oracle: bool = True,
+    check_states: np.ndarray | None = None,
+):
+    """Run the attack trajectory eagerly with oracle-replayed survival.
+
+    ``moeva`` is a configured ``Moeva2`` (``archive_size`` must be 0 —
+    pymoo has no elite archive; prefer ``dtype=jnp.float64``). ``x`` the
+    (S, D) initial states. ``check_states`` restricts the per-generation
+    oracle replay to a subset of state rows (python-loop cost control);
+    the kernel still evolves every state.
+
+    Returns ``{"x_ml", "f", "rounds_checked", "mismatches"}`` where
+    ``mismatches`` lists every (state, gen) whose kernel survivor set
+    differed from the oracle's — an empty list is the parity claim.
+    """
+    if moeva.archive_size:
+        raise ValueError("oracle GA requires archive_size=0 (pymoo semantics)")
+    if moeva.init != "tile":
+        raise ValueError("oracle GA supports init='tile' only")
+    s = x.shape[0]
+    dtype = moeva.dtype
+    codec = moeva.codec
+    pop_size = moeva.pop_size
+    asp = jnp.asarray(moeva.asp_points, dtype)
+    asp_np = np.asarray(asp, np.float64)
+    check_states = (
+        np.arange(s) if check_states is None else np.asarray(check_states)
+    )
+
+    if isinstance(minimize_class, (int, np.integer)):
+        minimize_class = np.full((s,), int(minimize_class))
+    xl_ml, xu_ml = moeva.constraints.get_feature_min_max(dynamic_input=x)
+    xl_ml = jnp.asarray(
+        np.broadcast_to(np.asarray(xl_ml, np.float64), x.shape), dtype
+    )
+    xu_ml = jnp.asarray(
+        np.broadcast_to(np.asarray(xu_ml, np.float64), x.shape), dtype
+    )
+    x_init_ml = jnp.asarray(x, dtype)
+    mc = jnp.asarray(minimize_class, jnp.int32)
+    params = jax.tree.map(lambda a: jnp.asarray(a, dtype), moeva.classifier.params)
+
+    xl_gen, xu_gen = codec_lib.genetic_bounds(codec, xl_ml, xu_ml)
+    x_init_mm = codec_lib.minmax_normalize(x_init_ml, xl_ml, xu_ml)
+
+    evaluate = jax.jit(
+        lambda pop: moeva._evaluate(
+            params, pop, x_init_ml, x_init_mm, xl_ml, xu_ml, mc
+        )[0]
+    )
+    offspring = jax.jit(
+        lambda k, pop: jax.vmap(
+            lambda k1, x1, xl1, xu1: make_offspring(
+                k1, moeva.tables, x1, xl1, xu1, moeva.n_offsprings,
+                crossover_prob=moeva.crossover_prob,
+                eta_mutation=moeva.eta_mutation,
+            )
+        )(jax.random.split(k, s), pop, xl_gen, xu_gen)
+    )
+    survive = jax.jit(
+        lambda k, f, st: sv.survive_batch(k, f, asp, st, pop_size)
+    )
+
+    # -- init: tile + warm-up survival (everyone survives) -----------------
+    key = jax.random.PRNGKey(moeva.seed)
+    key, k_init, k0 = jax.random.split(key, 3)
+    pop_x = tile_init(codec, x_init_ml, pop_size).astype(dtype)
+    pop_f = evaluate(pop_x)
+    norm0 = jax.vmap(lambda _: sv.NormState.init(N_OBJ, dtype))(jnp.arange(s))
+    _, norm_state, _ = survive(k0, pop_f, norm0)
+
+    oracle_states = {int(i): oracle.OracleNormState(N_OBJ) for i in check_states}
+    if check_oracle:
+        # warm-up round on the oracle side too: M == n_survive, so the
+        # selection is trivial but the ideal/worst/extreme memory updates
+        f_np = np.asarray(pop_f, np.float64)
+        gum_cut, gum_mem = sv._niche_gumbels(k0, (s,), pop_size, pop_size)
+        for i in check_states:
+            _, oracle_states[int(i)] = _oracle_survive_pinned(
+                f_np[i], asp_np, pop_size, oracle_states[int(i)],
+                np.asarray(gum_cut[i]), np.asarray(gum_mem[i]),
+            )
+
+    mismatches: list[dict] = []
+    rounds_checked = 0
+    rounds_skipped_nonfinite = 0
+    m_tot = pop_size + moeva.n_offsprings
+    for gen in range(moeva.n_gen - 1):
+        key, k_mate, k_surv = jax.random.split(key, 3)
+        off = offspring(k_mate, pop_x)
+        off_f = evaluate(off)
+        merged_x = jnp.concatenate([pop_x, off], axis=1)
+        merged_f = jnp.concatenate([pop_f, off_f], axis=1)
+        mask, norm_state, _ = survive(k_surv, merged_f, norm_state)
+        mask_np = np.asarray(mask)
+
+        if check_oracle:
+            f_np = np.asarray(merged_f, np.float64)
+            gum_cut, gum_mem = sv._niche_gumbels(k_surv, (s,), pop_size, m_tot)
+            for i in check_states:
+                # the oracle round ALWAYS runs (the ideal/worst/extreme
+                # memory must track every generation), but the survivor
+                # comparison only counts rounds whose merged F is fully
+                # finite: domain kernels legitimately emit inf violation
+                # sums (e.g. the LCLD amortisation at g == 1), and an inf
+                # objective turns the perpendicular-distance association
+                # into NaN arithmetic on BOTH sides — a regime where
+                # upstream pymoo's own pick order is float noise, not
+                # semantics (same class as the BLAS-dependent singular
+                # solve the oracle docstring pins)
+                finite = bool(np.isfinite(f_np[i]).all())
+                with warnings.catch_warnings():
+                    if not finite:
+                        warnings.simplefilter("ignore", RuntimeWarning)
+                    idx_o, oracle_states[int(i)] = _oracle_survive_pinned(
+                        f_np[i], asp_np, pop_size, oracle_states[int(i)],
+                        np.asarray(gum_cut[i]), np.asarray(gum_mem[i]),
+                    )
+                if not finite:
+                    rounds_skipped_nonfinite += 1
+                    continue
+                got = sorted(np.where(mask_np[i])[0].tolist())
+                want = sorted(np.asarray(idx_o).tolist())
+                rounds_checked += 1
+                if got != want:
+                    mismatches.append(
+                        {"state": int(i), "gen": gen + 1,
+                         "kernel": got, "oracle": want}
+                    )
+
+        # survivors-first, ascending original index — exactly the order the
+        # engine's cumsum/scatter permutation produces for the kept columns
+        keep = np.stack([np.where(mask_np[i])[0] for i in range(s)])
+        keep_j = jnp.asarray(keep)
+        pop_x = jnp.take_along_axis(merged_x, keep_j[..., None], axis=1)
+        pop_f = jnp.take_along_axis(merged_f, keep_j[..., None], axis=1)
+
+    x_ml = np.asarray(
+        codec_lib.genetic_to_ml(codec, pop_x, x_init_ml[:, None, :])
+    )
+    return {
+        "x_ml": x_ml,
+        "f": np.asarray(pop_f),
+        "rounds_checked": rounds_checked,
+        "rounds_skipped_nonfinite": rounds_skipped_nonfinite,
+        "mismatches": mismatches,
+    }

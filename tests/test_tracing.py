@@ -339,12 +339,25 @@ class TestFacades:
 
 class TestRecordSchema:
     def test_validate_record_rejects_missing_keys(self):
+        from moeva2_ijcai22_replication_tpu.observability import quality_block
+
         with pytest.raises(ValueError, match="telemetry"):
             validate_record({"execution": {}}, "bench")
         # PR-5 cost ledger: telemetry must carry the cost sub-block too
         with pytest.raises(ValueError, match="cost"):
             validate_record({"execution": {}, "telemetry": {}}, "bench")
-        rec = {"execution": {}, "telemetry": {"cost": {}}}
+        # PR-6 quality telemetry: and the quality sub-block
+        with pytest.raises(ValueError, match="quality"):
+            validate_record({"execution": {}, "telemetry": {"cost": {}}}, "bench")
+        with pytest.raises(ValueError, match="interior"):
+            validate_record(
+                {"execution": {}, "telemetry": {"cost": {}, "quality": {}}},
+                "bench",
+            )
+        rec = {
+            "execution": {},
+            "telemetry": {"cost": {}, "quality": quality_block()},
+        }
         assert validate_record(rec) is rec
         assert set(REQUIRED_RECORD_KEYS) == {"execution", "telemetry"}
 

@@ -1,17 +1,36 @@
 #!/usr/bin/env python
-"""Perf-regression watchdog: diff bench records, normalized by ledger cost.
+"""Perf+quality watchdog: diff bench records, normalized by ledger cost.
 
-The committed ``BENCH_r*.json`` series is the repo's performance
-trajectory; this tool turns it into an enforced contract. It compares the
-LATEST record against the best earlier value of each tracked metric and
-exits non-zero when a metric moved past the threshold in its bad
-direction — runnable standalone or as the repo check wired into tier-1
+The committed ``BENCH_r*.json`` series is the repo's performance AND
+correctness trajectory; this tool turns it into an enforced contract. It
+compares the LATEST record against the best earlier value of each tracked
+metric and exits non-zero when a metric moved past the threshold in its
+bad direction — runnable standalone or as the repo check wired into tier-1
 (``tests/test_cost_ledger.py::TestBenchDiffRepoCheck``).
 
     python tools/bench_diff.py BENCH_r04.json BENCH_r05.json   # pairwise
     python tools/bench_diff.py --check BENCH_r*.json           # whole series
     python tools/bench_diff.py --check                         # globs BENCH_r*.json
     python tools/bench_diff.py --check --threshold 0.4 ...
+    python tools/bench_diff.py --check --json ...              # + CI JSON line
+
+Quality metrics: records carrying a ``telemetry.quality`` (and/or
+``real_botnet.quality``) block expose interior-point success rates —
+o-rates pinned at interior generation budgets ({100, 300}, where the GA is
+budget-sensitive) — and an interior-rate DROP past ``--quality-threshold``
+fails the check exactly like a wall-clock regression. Quality drops are
+judged on ABSOLUTE rate deltas (rates live in [0, 1]; a relative delta on
+an 0.08 interior rate would trip on binomial noise): the seeded runs are
+deterministic, run-to-run movement comes only from execution-mode changes
+(chunking/compaction reshuffle the RNG), whose observed jitter is within
+a few binomial sigmas (~0.02 at 387 states) — the default 0.10 sits ~5
+sigma above that and far below the 4.5x class of survival-semantics
+regression this gate exists to catch (docs/DESIGN.md § quality watchdog).
+Records predating the quality block (r01–r05) simply aren't comparable on
+these metrics and are skipped as baselines, never failed — but once any
+baseline carries interior rates, a LATEST record without them fails:
+losing quality capture would disarm the gate exactly when a regression
+could hide behind it.
 
 Normalization: wall-clock metrics are divided by the work a record
 actually performed before comparison — the cost-ledger FLOPs total
@@ -40,6 +59,15 @@ import sys
 #: the default trips at 2.5x that noise floor, far below the 2x class of
 #: regression this watchdog exists to catch.
 DEFAULT_THRESHOLD = 0.25
+
+#: absolute interior-success-rate drop that fails the check (see module
+#: docstring for the noise-floor rationale).
+DEFAULT_QUALITY_THRESHOLD = 0.10
+
+#: o-columns tracked at each interior budget: o2 (misclassified) and o7
+#: (the full constrained-adversarial criterion) — the two the round-5
+#: adjudication pinned (0.199/0.080 @100).
+QUALITY_TRACKED = (("o2", 1), ("o7", 6))
 
 
 def load_record(path: str) -> dict | None:
@@ -152,14 +180,55 @@ def _values_by_basis(rec: dict, extract, work_fn) -> dict:
     return out
 
 
+def _quality_points(rec: dict) -> dict[str, tuple[float, int | None]]:
+    """Every interior-rate metric this record's quality blocks expose:
+    ``{"<block>.interior@<budget>.<o>": (rate, sample_gen)}`` over the
+    headline (``telemetry.quality``) and real-botnet quality blocks. The
+    sample's actual generation travels along so the diff can refuse to
+    compare samples taken at different gens (a cadence change relabels a
+    gen-150 sample as "@300"). ``full`` summaries are deliberately NOT
+    tracked — the full-budget rates are the saturated numbers whose
+    blindness this watchdog exists to fix."""
+    out: dict[str, tuple[float, int | None]] = {}
+    for label, dotted in (
+        ("quality", "telemetry.quality"),
+        ("real_botnet.quality", "real_botnet.quality"),
+    ):
+        block = _get(rec, dotted)
+        if not isinstance(block, dict):
+            continue
+        interior = block.get("interior") or {}
+        for budget, sample in sorted(interior.items()):
+            if budget == "full" or not isinstance(sample, dict):
+                continue
+            rates = sample.get("o_rates")
+            if not isinstance(rates, list):
+                continue
+            gen = sample.get("gen")
+            for oname, idx in QUALITY_TRACKED:
+                if idx < len(rates) and isinstance(
+                    rates[idx], (int, float)
+                ):
+                    out[f"{label}.interior@{budget}.{oname}"] = (
+                        float(rates[idx]),
+                        int(gen) if isinstance(gen, (int, float)) else None,
+                    )
+    return out
+
+
 def diff_series(
-    records: list[tuple[str, dict]], threshold: float
-) -> tuple[list[str], bool]:
+    records: list[tuple[str, dict]],
+    threshold: float,
+    quality_threshold: float = DEFAULT_QUALITY_THRESHOLD,
+) -> tuple[list[str], bool, list[dict]]:
     """Compare the last record pairwise against every earlier one, each
     pair in the strongest normalization basis BOTH sides support (ledger
     FLOPs > bench shape > raw), and judge the worst pair per metric.
-    Returns (report lines, any_regression)."""
+    Quality metrics (interior success rates) compare by absolute drop
+    against the best earlier value. Returns
+    (report lines, any_regression, structured entries for --json)."""
     lines: list[str] = []
+    entries: list[dict] = []
     regressed = False
     latest_path, latest = records[-1]
     earlier = records[:-1]
@@ -167,6 +236,7 @@ def diff_series(
         new_vals = _values_by_basis(latest, extract, work_fn)
         if not new_vals:
             lines.append(f"  {name}: absent in {latest_path} — skipped")
+            entries.append({"metric": name, "verdict": "skipped", "reason": "absent"})
             continue
         pairs = []
         for path, rec in earlier:
@@ -185,6 +255,9 @@ def diff_series(
             pairs.append((rel, path, old_v, new_v, basis))
         if not pairs:
             lines.append(f"  {name}: no comparable earlier record — skipped")
+            entries.append(
+                {"metric": name, "verdict": "skipped", "reason": "no_baseline"}
+            )
             continue
         rel, path, old_v, new_v, basis = max(pairs, key=lambda t: t[0])
         bad = rel > threshold
@@ -195,7 +268,114 @@ def diff_series(
             f"[{basis}-normalized] -> {abs(rel) * 100:.1f}% {direction}"
             + ("  ** REGRESSION **" if bad else "")
         )
-    return lines, regressed
+        entries.append(
+            {
+                "metric": name,
+                "kind": "perf",
+                "basis": basis,
+                "baseline": path,
+                "old": old_v,
+                "new": new_v,
+                "delta_rel": rel,
+                "verdict": "regression" if bad else "ok",
+            }
+        )
+
+    # -- quality: interior success rates, absolute-drop judged ------------
+    new_quality = _quality_points(latest)
+    old_quality: dict[str, list[tuple[str, float, int | None]]] = {}
+    for path, rec in earlier:
+        for name, (rate, gen) in _quality_points(rec).items():
+            old_quality.setdefault(name, []).append((path, rate, gen))
+    names = sorted(set(new_quality) | set(old_quality))
+    if not names:
+        lines.append(
+            f"  quality: no telemetry.quality interior rates in "
+            f"{latest_path} or any baseline — skipped"
+        )
+        entries.append(
+            {"metric": "quality", "verdict": "skipped", "reason": "absent"}
+        )
+    for name in names:
+        olds = old_quality.get(name, [])
+        if name not in new_quality:
+            # a metric any baseline exposed must not silently vanish: per
+            # BLOCK too (e.g. a crashed real_botnet step drops exactly the
+            # adjudicated-trajectory gate) — losing capture would disarm
+            # this check precisely when a regression could hide behind it
+            regressed = True
+            lines.append(
+                f"  {name}: present in {olds[0][0]} but ABSENT in "
+                f"{latest_path} — quality capture was lost  ** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "quality",
+                    "baseline": olds[0][0],
+                    "verdict": "regression",
+                    "reason": "quality_capture_lost",
+                }
+            )
+            continue
+        new_v, new_gen = new_quality[name]
+        if not olds:
+            lines.append(
+                f"  {name}: no comparable earlier record — skipped"
+            )
+            entries.append(
+                {"metric": name, "verdict": "skipped", "reason": "no_baseline"}
+            )
+            continue
+        # only samples taken at the SAME generation compare: a cadence
+        # change relabels a different gen as the same budget, which would
+        # either fake a regression or mask a real one
+        pairs = [
+            (old_v - new_v, path, old_v)
+            for path, old_v, old_gen in olds
+            if old_gen == new_gen
+        ]
+        if not pairs:
+            regressed = True
+            gens = sorted({g for _, _, g in olds})
+            lines.append(
+                f"  {name}: sampled at gen {new_gen} but baselines sampled "
+                f"at gen(s) {gens} — cadence changed, not comparable  "
+                "** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "quality",
+                    "verdict": "regression",
+                    "reason": "sample_gen_mismatch",
+                    "new_gen": new_gen,
+                    "baseline_gens": gens,
+                }
+            )
+            continue
+        drop, path, old_v = max(pairs, key=lambda t: t[0])
+        bad = drop > quality_threshold
+        regressed |= bad
+        direction = "worse" if drop > 0 else "better"
+        lines.append(
+            f"  {name}: {new_v:.4f} vs best {old_v:.4f} ({path}) "
+            f"[absolute, gen {new_gen}] -> {abs(drop):.4f} {direction}"
+            + ("  ** REGRESSION **" if bad else "")
+        )
+        entries.append(
+            {
+                "metric": name,
+                "kind": "quality",
+                "basis": "absolute",
+                "baseline": path,
+                "old": old_v,
+                "new": new_v,
+                "delta_abs": -drop,
+                "verdict": "regression" if bad else "ok",
+            }
+        )
+    return lines, regressed, entries
 
 
 def main(argv=None) -> int:
@@ -219,6 +399,20 @@ def main(argv=None) -> int:
         default=DEFAULT_THRESHOLD,
         help=f"relative regression that fails (default {DEFAULT_THRESHOLD})",
     )
+    parser.add_argument(
+        "--quality-threshold",
+        type=float,
+        default=DEFAULT_QUALITY_THRESHOLD,
+        help="absolute interior-success-rate drop that fails "
+        f"(default {DEFAULT_QUALITY_THRESHOLD})",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="append one machine-readable JSON line (per-metric basis, "
+        "delta, verdict) after the human-readable report, for CI "
+        "annotation",
+    )
     args = parser.parse_args(argv)
 
     paths = list(args.records)
@@ -241,19 +435,44 @@ def main(argv=None) -> int:
             f"bench_diff: {len(records)} usable record(s) — nothing to "
             "diff, trivially passing"
         )
+        if args.json:
+            print(
+                json.dumps(
+                    {"regressed": False, "reason": "insufficient_records",
+                     "usable_records": len(records), "metrics": []}
+                )
+            )
         return 0
 
     print(
         f"bench_diff: {records[-1][0]} vs {len(records) - 1} earlier "
-        f"record(s), threshold {args.threshold:.0%}"
+        f"record(s), threshold {args.threshold:.0%}, quality threshold "
+        f"{args.quality_threshold:g} abs"
     )
-    lines, regressed = diff_series(records, args.threshold)
+    lines, regressed, entries = diff_series(
+        records, args.threshold, args.quality_threshold
+    )
     print("\n".join(lines))
     if regressed:
         print("bench_diff: REGRESSION past threshold — failing")
-        return 1
-    print("bench_diff: ok")
-    return 0
+    else:
+        print("bench_diff: ok")
+    if args.json:
+        # one JSON line AFTER the unchanged human report: CI annotators
+        # parse the last line, humans read the rest
+        print(
+            json.dumps(
+                {
+                    "latest": records[-1][0],
+                    "baselines": [p for p, _ in records[:-1]],
+                    "threshold": args.threshold,
+                    "quality_threshold": args.quality_threshold,
+                    "regressed": regressed,
+                    "metrics": entries,
+                }
+            )
+        )
+    return 1 if regressed else 0
 
 
 if __name__ == "__main__":

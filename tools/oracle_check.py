@@ -1,0 +1,413 @@
+#!/usr/bin/env python
+"""Oracle-GA parity harness: budget-100 interior rates vs pymoo R-NSGA-III.
+
+ROADMAP item 5 / VERDICT r5's one epistemic gap: saturated full-budget
+records (all-ones o-rates) cannot distinguish two attacks, so a
+survival-semantics regression can hide forever; the *interior* budget-100
+rates move 4.5x under such a regression but were never validated against
+the reference pymoo semantics. This harness produces and checks the
+committed fixture ``tests/fixtures/oracle_interior_rates.json``:
+
+- per domain, per recorded seed: the ENGINE's budget-100 o1..o7 rates
+  (post-hoc f64 judgement — interior by construction, asserted), and
+- an ORACLE-GA run (``tests/oracles/oracle_ga.py``: the engine's loop in
+  f64 with every survival round replayed through the vendored pymoo
+  oracle in shared-trace mode) whose final rates AND zero-mismatch
+  survival trail are the reference-side counterpart.
+
+Domains: ``lcld_synth`` (code-derived schema + deterministic surrogate —
+reproduces in any container, the quick-tier fixture), ``botnet`` (the
+real reference artifacts at 48 states — engine rates only, slow tier) and
+``botnet_oracle`` (8 real botnet states with the full oracle replay).
+
+    python tools/oracle_check.py                  # check committed fixture
+    python tools/oracle_check.py --regen          # regenerate + rewrite it
+    python tools/oracle_check.py --domains lcld_synth --skip-oracle
+
+Fixture-regen procedure (docs/DESIGN.md § quality watchdog): run --regen
+on the CPU x64 test platform (the same env ``tests/conftest.py`` forces),
+eyeball the printed interiority/parity lines, commit the JSON. The
+quick/slow-tier tests in ``tests/test_quality.py`` then hold every future
+kernel change to these numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# standalone runs must pin the test platform BEFORE jax loads (imported
+# from pytest these are already set by tests/conftest.py) — including the
+# virtual 8-device mesh flag, so fixture generation and the fixture tests
+# run on byte-identical platforms
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # standalone `python tools/oracle_check.py`
+    sys.path.insert(0, REPO)
+TESTS = os.path.join(REPO, "tests")
+FIXTURE_PATH = os.path.join(TESTS, "fixtures", "oracle_interior_rates.json")
+REFERENCE = "/root/reference"
+
+#: recorded configs — the single source of truth the tests rerun from the
+#: committed fixture (which embeds a copy; a mismatch between the two
+#: fails the check so the fixture can never drift from the code).
+DOMAINS = {
+    "lcld_synth": {
+        "n_states": 16,
+        "n_gen": 100,
+        "n_pop": 40,
+        "n_offsprings": 20,
+        "archive_size": 0,
+        "norm": 2,
+        "seeds": [42, 43, 44],
+        "thresholds": {"f1": 0.5, "f2": 0.5},
+        "pool": 512,
+        "pool_seed": 11,
+        "oracle": True,
+        #: strictly-interior pins: a survival/operator semantics change
+        #: must MOVE these columns (0-indexed o2/o4), the lesson of the
+        #: saturated fixture that let the r3 kernel bug through
+        "interior_columns": [1, 3],
+    },
+    "botnet": {
+        "n_states": 48,
+        "n_gen": 100,
+        "n_pop": 100,
+        "n_offsprings": 50,
+        "archive_size": 0,
+        "norm": 2,
+        "seeds": [42, 43, 44],
+        "thresholds": {"f1": 0.5, "f2": 4.0},
+        "oracle": False,
+        "interior_columns": [1, 3],
+    },
+    "botnet_oracle": {
+        "n_states": 8,
+        "n_gen": 100,
+        "n_pop": 100,
+        "n_offsprings": 50,
+        "archive_size": 0,
+        "norm": 2,
+        "seeds": [42],
+        "thresholds": {"f1": 0.5, "f2": 4.0},
+        "oracle": True,
+        # 8 states is oracle-replay budget, not a rate sample — no
+        # interiority assertion at this n
+        "interior_columns": [],
+    },
+}
+
+#: |engine mean - oracle-GA mean| bound per tracked column. The two runs
+#: share seeds but not arithmetic (f32 scan vs f64 eager), so their
+#: trajectories decohere chaotically and only the rate *distribution* is
+#: comparable: at 16 states x 3 seeds the difference of two binomial
+#: means has sigma ~0.1; 0.3 is ~3 sigma — loose enough for GA noise,
+#: far below the 4.5x semantics-regression class.
+PARITY_TOLERANCE = 0.3
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_lcld_synth(cfg: dict):
+    """Deterministic, container-independent problem: code-derived LCLD
+    schema, seed-pinned random surrogate, candidates picked as an evenly
+    spread difficulty mix above the decision threshold (so budget-100
+    rates are interior: the easiest flip early, the hardest never do)."""
+    import tempfile
+
+    from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_lcld,
+        synth_lcld_schema,
+    )
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    tmp = tempfile.mkdtemp(prefix="oracle_check_")
+    paths = synth_lcld_schema(tmp)
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+    pool = synth_lcld(cfg["pool"], cons.schema, seed=cfg["pool_seed"])
+    # scaler envelope = data ∪ per-state dynamic bounds so attacked
+    # candidates at their bound extremes stay inside [0, 1] (the judged
+    # distance is scaler-space — bench.py's rule)
+    xl_d, xu_d = cons.get_feature_min_max(dynamic_input=pool)
+    lo = np.minimum(
+        pool.min(0),
+        np.broadcast_to(np.asarray(xl_d, float), pool.shape).min(0),
+    )
+    hi = np.maximum(
+        pool.max(0),
+        np.broadcast_to(np.asarray(xu_d, float), pool.shape).max(0),
+    )
+    scaler = fit_minmax(lo, hi)
+    p1 = np.asarray(sur.predict_proba(scaler.transform(pool)))[:, 1]
+    cand = np.where(p1 >= cfg["thresholds"]["f1"])[0]
+    cand = cand[np.argsort(-p1[cand])]
+    sel = cand[np.linspace(0, len(cand) - 1, cfg["n_states"]).astype(int)]
+    return {"constraints": cons, "surrogate": sur, "scaler": scaler,
+            "x": pool[sel]}
+
+
+def build_botnet(cfg: dict):
+    """Real reference artifacts (None when the reference tree is absent —
+    callers skip, never fake, these domains)."""
+    if not os.path.isdir(REFERENCE):
+        return None
+    from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+    from moeva2_ijcai22_replication_tpu.models.io import load_classifier
+    from moeva2_ijcai22_replication_tpu.models.scalers import load_joblib_scaler
+
+    cons = BotnetConstraints(
+        f"{REFERENCE}/data/botnet/features.csv",
+        f"{REFERENCE}/data/botnet/constraints.csv",
+    )
+    sur = load_classifier(f"{REFERENCE}/models/botnet/nn.model")
+    scaler = load_joblib_scaler(f"{REFERENCE}/models/botnet/scaler.joblib")
+    x = np.load(f"{REFERENCE}/data/botnet/x_candidates_common.npy")
+    return {"constraints": cons, "surrogate": sur, "scaler": scaler,
+            "x": x[: cfg["n_states"]]}
+
+
+def build_problem(name: str, cfg: dict):
+    if name == "lcld_synth":
+        return build_lcld_synth(cfg)
+    return build_botnet(cfg)
+
+
+def _calculator(problem, cfg):
+    from moeva2_ijcai22_replication_tpu.attacks.objective import (
+        ObjectiveCalculator,
+    )
+
+    return ObjectiveCalculator(
+        classifier=problem["surrogate"],
+        constraints=problem["constraints"],
+        thresholds=dict(cfg["thresholds"]),
+        min_max_scaler=problem["scaler"],
+        ml_scaler=problem["scaler"],
+        minimize_class=1,
+        norm=cfg["norm"],
+    )
+
+
+def _engine(problem, cfg, seed, dtype=None):
+    from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+
+    kw = {} if dtype is None else {"dtype": dtype}
+    return Moeva2(
+        classifier=problem["surrogate"],
+        constraints=problem["constraints"],
+        ml_scaler=problem["scaler"],
+        norm=cfg["norm"],
+        n_gen=cfg["n_gen"],
+        n_pop=cfg["n_pop"],
+        n_offsprings=cfg["n_offsprings"],
+        seed=seed,
+        archive_size=cfg["archive_size"],
+        **kw,
+    )
+
+
+def engine_rates(problem, cfg, seed) -> list[float]:
+    """The production engine's (f32 scan, CPU test platform) budget-100
+    rates at this seed — the number the quick/slow fixture tests pin."""
+    moeva = _engine(problem, cfg, seed)
+    res = moeva.generate(problem["x"], minimize_class=1)
+    calc = _calculator(problem, cfg)
+    return [float(v) for v in calc.success_rate_3d(problem["x"], res.x_ml)]
+
+
+def oracle_ga_rates(problem, cfg, seed, check_states=None) -> dict:
+    """The f64 oracle-GA trajectory at this seed: rates + the survival
+    cross-check trail (rounds checked, mismatches — must be zero)."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, TESTS)
+    try:
+        from oracles.oracle_ga import run_oracle_ga
+    finally:
+        sys.path.remove(TESTS)
+    moeva = _engine(problem, cfg, seed, dtype=jnp.float64)
+    out = run_oracle_ga(
+        moeva, problem["x"], minimize_class=1, check_states=check_states
+    )
+    calc = _calculator(problem, cfg)
+    rates = [float(v) for v in calc.success_rate_3d(problem["x"], out["x_ml"])]
+    return {
+        "o_rates": rates,
+        "rounds_checked": int(out["rounds_checked"]),
+        # rounds whose merged F contained inf (domain kernels emit inf
+        # violation sums on degenerate candidates): the NaN-association
+        # regime where pymoo's own pick order is float noise — replayed
+        # for state continuity, excluded from the exact comparison
+        "rounds_skipped_nonfinite": int(out["rounds_skipped_nonfinite"]),
+        "mismatches": out["mismatches"],
+    }
+
+
+def run_domain(name: str, cfg: dict, skip_oracle: bool = False) -> dict | None:
+    problem = build_problem(name, cfg)
+    if problem is None:
+        log(f"[oracle_check] {name}: reference artifacts absent — skipped")
+        return None
+    result: dict = {"config": {k: v for k, v in cfg.items()}, "engine": {}}
+    for seed in cfg["seeds"]:
+        rates = engine_rates(problem, cfg, seed)
+        result["engine"][str(seed)] = rates
+        log(f"[oracle_check] {name} seed {seed} engine o1..o7: "
+            + " ".join(f"{r:.3f}" for r in rates))
+    engine_mean = np.mean(
+        [result["engine"][str(s)] for s in cfg["seeds"]], axis=0
+    )
+    result["engine"]["mean"] = [float(v) for v in engine_mean]
+    for col in cfg["interior_columns"]:
+        assert 0.0 < engine_mean[col] < 1.0, (
+            f"{name}: mean o{col + 1}={engine_mean[col]:.3f} is saturated — "
+            "the fixture must stay interior to stay sensitive (retune the "
+            "config before committing)"
+        )
+    if cfg["oracle"] and not skip_oracle:
+        result["oracle_ga"] = {}
+        for seed in cfg["seeds"]:
+            o = oracle_ga_rates(problem, cfg, seed)
+            assert not o["mismatches"], (
+                f"{name} seed {seed}: kernel survival diverged from the "
+                f"pymoo oracle at {len(o['mismatches'])} of "
+                f"{o['rounds_checked']} rounds: {o['mismatches'][:3]}"
+            )
+            result["oracle_ga"][str(seed)] = o
+            log(
+                f"[oracle_check] {name} seed {seed} oracle-GA o1..o7: "
+                + " ".join(f"{r:.3f}" for r in o["o_rates"])
+                + f"  ({o['rounds_checked']} survival rounds, 0 mismatches)"
+            )
+        oracle_mean = np.mean(
+            [result["oracle_ga"][str(s)]["o_rates"] for s in cfg["seeds"]],
+            axis=0,
+        )
+        result["oracle_ga"]["mean"] = [float(v) for v in oracle_mean]
+        deltas = np.abs(engine_mean - oracle_mean)
+        result["parity"] = {
+            "max_abs_mean_delta": float(deltas.max()),
+            "tolerance": PARITY_TOLERANCE,
+        }
+        log(f"[oracle_check] {name} engine-vs-oracle max |Δmean|: "
+            f"{deltas.max():.3f} (tolerance {PARITY_TOLERANCE})")
+        assert deltas.max() <= PARITY_TOLERANCE, (
+            f"{name}: engine rates sit outside the oracle seed band "
+            f"(max |Δmean| {deltas.max():.3f} > {PARITY_TOLERANCE})"
+        )
+    return result
+
+
+def check_against_fixture(results: dict, fixture: dict) -> list[str]:
+    """Exact reproduction check of freshly computed results vs the
+    committed fixture (engine rates per seed; oracle rates when present)."""
+    problems = []
+    for name, res in results.items():
+        committed = (fixture.get("domains") or {}).get(name)
+        if committed is None:
+            problems.append(f"{name}: not in committed fixture")
+            continue
+        if committed["config"] != res["config"]:
+            problems.append(f"{name}: config drifted from committed fixture")
+        for seed, rates in res["engine"].items():
+            want = committed["engine"].get(seed)
+            if want is None or not np.allclose(rates, want, atol=0):
+                problems.append(
+                    f"{name} seed {seed}: engine rates {rates} != "
+                    f"committed {want}"
+                )
+        for seed, o in (res.get("oracle_ga") or {}).items():
+            want = (committed.get("oracle_ga") or {}).get(seed)
+            if seed == "mean" or want is None:
+                continue
+            if not np.allclose(o["o_rates"], want["o_rates"], atol=0):
+                problems.append(
+                    f"{name} seed {seed}: oracle-GA rates drifted from "
+                    "committed fixture"
+                )
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--regen", action="store_true",
+        help="recompute everything and rewrite the committed fixture",
+    )
+    parser.add_argument(
+        "--domains", nargs="*", default=list(DOMAINS),
+        choices=list(DOMAINS), help="subset of domains to run",
+    )
+    parser.add_argument(
+        "--skip-oracle", action="store_true",
+        help="engine rates only (no pymoo-oracle trajectory replay)",
+    )
+    args = parser.parse_args(argv)
+
+    results = {}
+    for name in args.domains:
+        res = run_domain(name, DOMAINS[name], skip_oracle=args.skip_oracle)
+        if res is not None:
+            results[name] = res
+
+    if args.regen:
+        doc = {
+            "generated_by": "tools/oracle_check.py --regen (CPU x64 test platform)",
+            "note": (
+                "Budget-100 interior success rates, oracle-validated: "
+                "engine = the production f32 scan; oracle_ga = the f64 "
+                "eager trajectory with EVERY survival round replayed "
+                "through the vendored pymoo R-NSGA-III oracle in "
+                "shared-trace mode (zero mismatches). Interior columns "
+                "are strictly inside (0, 1) by construction so any "
+                "survival/operator semantics change moves them. Regen: "
+                "python tools/oracle_check.py --regen  (then commit)."
+            ),
+            "parity_tolerance": PARITY_TOLERANCE,
+            "domains": results,
+        }
+        os.makedirs(os.path.dirname(FIXTURE_PATH), exist_ok=True)
+        with open(FIXTURE_PATH, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+        log(f"[oracle_check] wrote {FIXTURE_PATH}")
+        return 0
+
+    try:
+        with open(FIXTURE_PATH) as fh:
+            fixture = json.load(fh)
+    except OSError:
+        log(f"[oracle_check] no committed fixture at {FIXTURE_PATH}; "
+            "run with --regen first")
+        return 2
+    problems = check_against_fixture(results, fixture)
+    for p in problems:
+        log(f"[oracle_check] MISMATCH: {p}")
+    log(f"[oracle_check] {'FAIL' if problems else 'ok'} "
+        f"({len(results)} domain(s) checked)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -550,6 +550,13 @@ def run_serving_bench() -> dict | None:
                 f"p99 {lv['p99_ms']} ms, occupancy {lv['mean_batch_occupancy']}, "
                 f"rejected {lv['rejected']}"
             )
+        knee = record["telemetry"]["slo"]["knee"]
+        shed = record["telemetry"]["slo"]["shed"]
+        log(
+            f"[bench] serving knee: {knee['knee_rps']} rps "
+            f"(first saturated {knee['first_saturated_rps']}), "
+            f"shed {shed['total']}"
+        )
         return record
     except Exception as e:
         log(f"[bench] serving metric skipped: {e}")

@@ -23,12 +23,14 @@ keep every committed record carrying the shared ``execution`` +
 ``telemetry`` schema.
 """
 
+from .capacity import CapacityModel
 from .ledger import (
     LEDGER,
     CostLedger,
     LedgeredJit,
     LedgerEntry,
     configure_ledger,
+    current_ledger_context,
     get_ledger,
     ledger_context,
 )
@@ -48,6 +50,17 @@ from .records import (
     telemetry_block,
     validate_record,
 )
+from .slo import (
+    DEFAULT_LATENCY_BUCKETS,
+    SHED_CAUSES,
+    SLO_KEYS,
+    STAGES,
+    Histogram,
+    SloTracker,
+    detect_knee,
+    slo_block,
+    validate_slo,
+)
 from .trace import (
     Trace,
     TraceRecorder,
@@ -61,18 +74,27 @@ from .trace import (
 
 __all__ = [
     "DEFAULT_INTERIOR_BUDGETS",
+    "DEFAULT_LATENCY_BUCKETS",
     "LEDGER",
     "QUALITY_KEYS",
     "REQUIRED_RECORD_KEYS",
+    "SHED_CAUSES",
+    "SLO_KEYS",
+    "STAGES",
+    "CapacityModel",
     "CostLedger",
+    "Histogram",
     "LedgerEntry",
     "LedgeredJit",
+    "SloTracker",
     "Trace",
     "TraceRecorder",
     "build_identity",
     "configure_ledger",
+    "current_ledger_context",
     "current_trace",
     "default_recorder",
+    "detect_knee",
     "device_memory_stats",
     "get_ledger",
     "interior_summary",
@@ -82,6 +104,7 @@ __all__ = [
     "quality_block",
     "recorder_for",
     "sample_from_per_state",
+    "slo_block",
     "telemetry_block",
     "trim_quality",
     "use_trace",

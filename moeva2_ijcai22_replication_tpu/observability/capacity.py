@@ -1,0 +1,232 @@
+"""Ledger-backed capacity model: what a replica can sustain, per domain.
+
+PR 5's cost ledger knows two numbers nothing else in the stack knows:
+the XLA cost model's FLOPs per compiled executable (what a dispatch
+*asks* the device for) and the attributed run seconds per executable
+(what the device *delivered*). Joining them over the serving layer's
+batch stream yields an honest capacity model, the missing half of
+ROADMAP item 4's admission-control story:
+
+- **predicted FLOPs per request** per traffic class — a class is
+  ``(loss strategy, bucket, budget)`` within a domain, exactly the
+  coordinates that select a compiled program — from the ledger entries
+  of the executables each batch actually dispatched, divided by the
+  requests that rode the batch;
+- **achieved FLOP/s** — model FLOPs over attributed run seconds across
+  the window (the roofline's achieved rate, aggregated per domain);
+- **max sustainable QPS** = achieved FLOP/s / predicted FLOPs per
+  request — the rate at which device time alone saturates. By
+  construction this equals window requests / window run seconds; both
+  factors are published so the formula (and its degradation when the
+  cost model is absent) stays auditable;
+- **utilization & headroom** — attributed device seconds over the
+  window's wall span: how much of the replica the current offered load
+  already consumes, and what fraction remains;
+- **calibration error** — mean |predicted - actual| / actual run
+  seconds per batch, where predicted = batch FLOPs / window achieved
+  FLOP/s. Zero means FLOPs are a faithful time predictor across classes
+  (admission control can price requests in FLOPs); large means classes
+  sit at different roofline points (low arithmetic-intensity programs
+  run memory-bound) and FLOPs alone under-prices some traffic — the
+  caveat docs/DESIGN.md § SLO & capacity spells out.
+
+Feeding is host-side only (the serving dispatch closures call
+:meth:`CapacityModel.note_batch` with numbers they already computed for
+the trace spans), windowed per domain (``serving.capacity_window``
+batches) so the published capacity reflects recent traffic, not a cold
+start's. Compile-bearing dispatches are excluded — their wall-clock is
+compile, not capacity. No flops available (model-less backend) degrades
+to ``basis: "run_seconds"``: max QPS stays (requests / run seconds),
+prediction and calibration go None rather than wrong.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class _BatchObs:
+    """One pure-run batch dispatch, as the capacity model sees it."""
+
+    t: float  #: monotonic completion time (window wall-span basis)
+    klass: str  #: traffic class: "{strategy}|b{bucket}|g{budget}"
+    requests: int
+    rows: int
+    run_s: float
+    flops: float | None  #: ledger model FLOPs for the dispatch set
+
+
+class CapacityModel:
+    """Windowed per-domain capacity aggregation over serving batches."""
+
+    def __init__(
+        self,
+        window: int = 256,
+        clock=time.monotonic,
+    ):
+        self.window = int(window)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._by_domain: dict[str, collections.deque] = {}
+
+    @staticmethod
+    def class_key(strategy, bucket, budget) -> str:
+        return f"{strategy}|b{bucket}|g{budget}"
+
+    # -- ingestion -----------------------------------------------------------
+    def note_batch(
+        self,
+        domain: str,
+        *,
+        strategy,
+        bucket,
+        budget,
+        requests: int,
+        rows: int,
+        run_s: float,
+        flops: float | None,
+    ) -> None:
+        """Fold one pure-run batch dispatch into the domain's window.
+        Callers must not feed compile-bearing dispatches (their duration
+        is compile wall-clock, not sustainable capacity)."""
+        if run_s <= 0 or requests < 1:
+            return
+        obs = _BatchObs(
+            t=self.clock(),
+            klass=self.class_key(strategy, bucket, budget),
+            requests=int(requests),
+            rows=int(rows),
+            run_s=float(run_s),
+            flops=float(flops) if flops else None,
+        )
+        with self._lock:
+            dq = self._by_domain.get(domain)
+            if dq is None:
+                dq = self._by_domain[domain] = collections.deque(
+                    maxlen=self.window
+                )
+            dq.append(obs)
+
+    # -- export --------------------------------------------------------------
+    def domain_block(self, domain: str) -> dict | None:
+        """The per-domain capacity block /healthz publishes."""
+        with self._lock:
+            dq = self._by_domain.get(domain)
+            obs = list(dq) if dq else []
+        if not obs:
+            return None
+        requests = sum(o.requests for o in obs)
+        rows = sum(o.rows for o in obs)
+        run_s = sum(o.run_s for o in obs)
+        with_flops = [o for o in obs if o.flops is not None]
+        flops_total = sum(o.flops for o in with_flops)
+        run_s_flops = sum(o.run_s for o in with_flops)
+        req_flops = sum(o.requests for o in with_flops)
+
+        predicted_flops_per_request = (
+            flops_total / req_flops if flops_total and req_flops else None
+        )
+        achieved_flops_s = (
+            flops_total / run_s_flops if flops_total and run_s_flops > 0 else None
+        )
+        # max QPS: achieved FLOP/s over predicted FLOPs/request when the
+        # cost model is present (algebraically requests/run_s over the
+        # flops-bearing subset); the run-seconds rate otherwise
+        if achieved_flops_s is not None and predicted_flops_per_request:
+            max_qps = achieved_flops_s / predicted_flops_per_request
+            basis = "ledger_flops"
+        else:
+            max_qps = requests / run_s
+            basis = "run_seconds"
+
+        # utilization: attributed device seconds over the window's wall
+        # span — first dispatch START (its completion time minus its own
+        # run) to last completion. One batch spans no wall time —
+        # utilization needs a window, not a point.
+        span = (obs[-1].t - obs[0].t) + obs[0].run_s
+        utilization = min(run_s / span, 1.0) if len(obs) > 1 and span > 0 else None
+
+        # calibration: does the FLOPs model predict where run time went?
+        calibration = None
+        if achieved_flops_s is not None:
+            errs = []
+            for o in with_flops:
+                predicted_s = o.flops / achieved_flops_s
+                errs.append(abs(predicted_s - o.run_s) / o.run_s)
+            if errs:
+                calibration = {
+                    "mean_abs_rel_err": round(sum(errs) / len(errs), 4),
+                    "max_abs_rel_err": round(max(errs), 4),
+                    "n": len(errs),
+                }
+
+        per_class: dict = {}
+        for o in obs:
+            c = per_class.setdefault(
+                o.klass,
+                {"dispatches": 0, "requests": 0, "run_s": 0.0, "flops": 0.0,
+                 "flops_known": 0, "requests_flops": 0},
+            )
+            c["dispatches"] += 1
+            c["requests"] += o.requests
+            c["run_s"] += o.run_s
+            if o.flops is not None:
+                c["flops"] += o.flops
+                c["flops_known"] += 1
+                c["requests_flops"] += o.requests
+        for c in per_class.values():
+            # denominator is the requests on flops-BEARING dispatches only
+            # (mirroring the domain-level req_flops): a class mixing
+            # flops-less observations in must not dilute the per-request
+            # prediction admission control prices traffic with
+            c["predicted_flops_per_request"] = (
+                round(c["flops"] / c["requests_flops"], 1)
+                if c["flops"] and c["requests_flops"]
+                else None
+            )
+            c["mean_run_s"] = round(c["run_s"] / c["dispatches"], 6)
+            c["run_s"] = round(c["run_s"], 6)
+            del c["flops"], c["requests_flops"]
+
+        return {
+            "window_batches": len(obs),
+            "window_limit": self.window,
+            "requests": requests,
+            "rows": rows,
+            "run_s": round(run_s, 6),
+            "basis": basis,
+            "predicted_flops_per_request": (
+                round(predicted_flops_per_request, 1)
+                if predicted_flops_per_request
+                else None
+            ),
+            "achieved_flops_s": (
+                round(achieved_flops_s, 1) if achieved_flops_s else None
+            ),
+            "max_sustainable_qps": round(max_qps, 2),
+            "utilization": (
+                round(utilization, 4) if utilization is not None else None
+            ),
+            "headroom": (
+                round(1.0 - utilization, 4) if utilization is not None else None
+            ),
+            "calibration": calibration,
+            "per_class": per_class,
+        }
+
+    def snapshot(self) -> dict:
+        """All domains' capacity blocks — the /healthz ``capacity`` key."""
+        with self._lock:
+            domains = list(self._by_domain)
+        return {
+            "window": self.window,
+            "by_domain": {
+                d: blk
+                for d in sorted(domains)
+                if (blk := self.domain_block(d)) is not None
+            },
+        }

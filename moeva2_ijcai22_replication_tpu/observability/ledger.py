@@ -59,6 +59,13 @@ def ledger_context(**attrs):
         _context.reset(token)
 
 
+def current_ledger_context() -> dict:
+    """The ambient :func:`ledger_context` attrs of the calling extent —
+    how a dispatch closure running under the microbatcher reads the
+    batch composition (bucket, batch_requests) the batcher pushed."""
+    return dict(_context.get() or {})
+
+
 # -- cost-model probes --------------------------------------------------------
 def probe_cost_analysis(compiled) -> dict | None:
     """Best-effort ``{flops, bytes_accessed, transcendentals}`` from
@@ -437,6 +444,26 @@ class CostLedger:
                 for (e, c, d, r) in rows
             ],
         }
+
+    def flops_for(self, executables) -> float | None:
+        """Static model FLOPs of a dispatch set (an iterable of entry keys,
+        one dispatch each, or a ``{key: dispatch_count}`` mapping) — the
+        capacity model's per-batch cost. None when no dispatched
+        executable carries a cost model."""
+        items = (
+            executables.items()
+            if isinstance(executables, dict)
+            else ((k, 1) for k in executables)
+        )
+        total = 0.0
+        have = False
+        with self._lock:
+            for k, n in items:
+                e = self._entries.get(k)
+                if e is not None and e.flops is not None:
+                    total += e.flops * n
+                    have = True
+        return total if have else None
 
     def roofline_for(self, executables, seconds: float) -> dict | None:
         """Static cost of a dispatch set joined with a caller-measured

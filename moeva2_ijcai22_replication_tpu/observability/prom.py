@@ -63,6 +63,15 @@ _HELP = {
     "quality_best_dist": "Best engine-objective distance among successful candidates",
     "quality_batches": "MoEvA batches that contributed quality samples",
     "quality_gen": "Generation steps executed by the last sampled MoEvA batch",
+    "stage_latency_seconds": "Per-request latency by serving stage, fixed log-spaced buckets. Additive end-to-end decomposition: validate + queue_wait + batch_wait + dispatch; device_run/decode are sub-stages INSIDE dispatch (and dispatch includes compile wall-clock on cold batches, which device_run excludes)",
+    "shed_requests": "Requests shed or deadline-overrun, by cause and by the stage that consumed the deadline budget",
+    "capacity_max_sustainable_qps": "Ledger-predicted max sustainable requests/s per domain (achieved FLOP/s over predicted FLOPs per request)",
+    "capacity_predicted_flops_per_request": "Predicted model FLOPs per request per domain (cost-ledger entries over the capacity window)",
+    "capacity_achieved_flops_s": "Achieved FLOP/s per domain over the capacity window (model FLOPs over attributed run seconds)",
+    "capacity_utilization": "Attributed device seconds over the capacity window's wall span, per domain",
+    "capacity_headroom": "1 - utilization: fraction of the replica's device time still available, per domain",
+    "capacity_calibration_error": "Mean |predicted - actual| / actual run seconds per batch: how faithfully FLOPs predict device time",
+    "capacity_window_batches": "Batch dispatches currently in the capacity window, per domain",
 }
 
 
@@ -170,6 +179,95 @@ def _quality_lines(prefix: str, block: dict, lines: list[str]) -> None:
                 )
 
 
+def _slo_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """SLO exposition: one NATIVE histogram family for the per-stage
+    latency decomposition (``_bucket``/``_sum``/``_count`` with
+    ``{domain, stage, le}`` labels — cumulative counts, so scrapes merge
+    across replicas) plus a labeled shed counter family
+    ``{domain, cause, stage}``."""
+    stages = block.get("stages") or {}
+    rows = [
+        (domain, stage, snap)
+        for domain, by_stage in sorted(stages.items())
+        for stage, snap in sorted(by_stage.items())
+        if isinstance(snap, dict) and snap.get("buckets")
+    ]
+    if rows:
+        n = _name(prefix, "stage_latency_seconds")
+        _family(lines, n, "histogram", "stage_latency_seconds")
+        for domain, stage, snap in rows:
+            labels = (
+                f'domain="{_escape_label(domain)}",'
+                f'stage="{_escape_label(stage)}"'
+            )
+            for le, cum in snap["buckets"]:
+                le_txt = "+Inf" if le == "+Inf" else _fmt(le)
+                lines.append(
+                    f'{n}_bucket{{{labels},le="{le_txt}"}} {int(cum)}'
+                )
+            lines.append(f"{n}_sum{{{labels}}} {_fmt(snap.get('sum', 0.0))}")
+            lines.append(f"{n}_count{{{labels}}} {int(snap.get('count', 0))}")
+    shed = (block.get("shed") or {}).get("by_domain") or {}
+    shed_rows = [
+        (domain, cause, stage, v)
+        for domain, by_cause in sorted(shed.items())
+        for cause, by_stage in sorted(by_cause.items())
+        for stage, v in sorted(by_stage.items())
+        if isinstance(v, int)
+    ]
+    if shed_rows:
+        n = _name(prefix, "shed_requests", "_total")
+        _family(lines, n, "counter", "shed_requests")
+        for domain, cause, stage, v in shed_rows:
+            lines.append(
+                f'{n}{{domain="{_escape_label(domain)}",'
+                f'cause="{_escape_label(cause)}",'
+                f'stage="{_escape_label(stage)}"}} {v}'
+            )
+
+
+def _capacity_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Capacity-model exposition: one ``{domain}``-labeled gauge family
+    per published measure, so a load balancer can scrape max sustainable
+    QPS and headroom next to the latency histograms."""
+    by_domain = block.get("by_domain") or {}
+    if not by_domain:
+        return
+    fields = (
+        ("max_sustainable_qps", "max_sustainable_qps"),
+        ("predicted_flops_per_request", "predicted_flops_per_request"),
+        ("achieved_flops_s", "achieved_flops_s"),
+        ("utilization", "utilization"),
+        ("headroom", "headroom"),
+        ("window_batches", "window_batches"),
+    )
+    for src, key in fields:
+        rows = [
+            (domain, d.get(src))
+            for domain, d in sorted(by_domain.items())
+            if isinstance(d.get(src), (int, float))
+            and not isinstance(d.get(src), bool)
+        ]
+        if not rows:
+            continue
+        n = _name(prefix, f"capacity_{key}")
+        _family(lines, n, "gauge", f"capacity_{key}")
+        for domain, v in rows:
+            lines.append(f'{n}{{domain="{_escape_label(domain)}"}} {_fmt(v)}')
+    cal_rows = [
+        (domain, (d.get("calibration") or {}).get("mean_abs_rel_err"))
+        for domain, d in sorted(by_domain.items())
+        if isinstance(
+            (d.get("calibration") or {}).get("mean_abs_rel_err"), (int, float)
+        )
+    ]
+    if cal_rows:
+        n = _name(prefix, "capacity_calibration_error")
+        _family(lines, n, "gauge", "capacity_calibration_error")
+        for domain, v in cal_rows:
+            lines.append(f'{n}{{domain="{_escape_label(domain)}"}} {_fmt(v)}')
+
+
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     """ServiceMetrics snapshot dict -> Prometheus exposition text."""
     lines: list[str] = []
@@ -180,6 +278,12 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     quality_block = snapshot.get("quality")
     if isinstance(quality_block, dict):
         _quality_lines(prefix, quality_block, lines)
+    slo = snapshot.get("slo")
+    if isinstance(slo, dict):
+        _slo_lines(prefix, slo, lines)
+    capacity = snapshot.get("capacity")
+    if isinstance(capacity, dict):
+        _capacity_lines(prefix, capacity, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
@@ -209,7 +313,10 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     # gauges, one-level dicts of numbers (cache stats) become one gauge per
     # sub-key — so engine/artifact cache health is scrapeable too
     for key, v in sorted(snapshot.items()):
-        if key in ("counters", "gauges", "streams", "cost_ledger", "quality"):
+        if key in (
+            "counters", "gauges", "streams", "cost_ledger", "quality",
+            "slo", "capacity",
+        ):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             n = _name(prefix, key)

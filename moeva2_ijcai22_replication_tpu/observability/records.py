@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from .ledger import get_ledger
 from .quality import quality_block, validate_quality
+from .slo import validate_slo
 from .trace import Trace, TraceRecorder, device_memory_stats
 
 #: the keys every bench / grid-report / serving-sweep record must carry.
@@ -29,6 +30,7 @@ def telemetry_block(
     ledger=None,
     ledger_since: dict | None = None,
     quality: dict | None = None,
+    slo: dict | None = None,
 ) -> dict:
     """JSON-ready telemetry summary for a record: span totals (from a
     PhaseTimer), trace id + event count (from a Trace), recorder counters,
@@ -43,11 +45,18 @@ def telemetry_block(
     ``quality`` is a pre-assembled ``observability.quality.quality_block``
     (convergence curve + interior-point summary); omitted, an empty but
     schema-valid block is inserted so every producer satisfies the
-    ``telemetry.quality`` schema unconditionally."""
+    ``telemetry.quality`` schema unconditionally.
+
+    ``slo`` is a pre-assembled ``observability.slo.slo_block`` (stage
+    latency histograms + shed attribution + saturation knee) — serving
+    producers pass it (``validate_record`` enforces it on serving
+    records); batch producers have no request path and omit it."""
     block: dict = {"hbm": device_memory_stats(device)}
     block["quality"] = validate_quality(
         quality if quality is not None else quality_block()
     )
+    if slo is not None:
+        block["slo"] = validate_slo(slo)
     if timer is not None:
         block["spans_s"] = {k: round(v, 4) for k, v in timer.spans.items()}
         block["span_total_s"] = round(sum(timer.spans.values()), 4)
@@ -89,6 +98,21 @@ def validate_record(record: dict, kind: str = "record") -> dict:
             "every committed number"
         )
     validate_quality(telemetry["quality"], kind)
+    # serving records additionally carry the SLO block (stage histograms,
+    # shed attribution, saturation knee) — the request path is the one
+    # producer with a latency decomposition to report, and dropping it
+    # would disarm the bench_diff --slo gate exactly like losing quality
+    # capture would disarm the quality gate
+    if kind == "serving":
+        if "slo" not in telemetry:
+            raise ValueError(
+                "serving record's telemetry block is missing the 'slo' "
+                "sub-block: assemble it with observability.slo.slo_block "
+                "so stage histograms, shed attribution, and the "
+                "saturation knee travel with every committed serving "
+                "number"
+            )
+        validate_slo(telemetry["slo"], kind)
     return record
 
 

@@ -1,0 +1,406 @@
+"""Serving SLO observability: latency-decomposition histograms, shed
+attribution, and saturation-knee detection.
+
+The serving layer's latency evidence so far is windowed p50/p99 samples
+(``ServiceMetrics.observe``) — fine for a dashboard sparkline, useless for
+an SLO: quantiles over a sliding window can't be aggregated across
+replicas, say nothing about *where* a slow request spent its time, and a
+rejected or expired request vanishes from them entirely. This module is
+the measurement substrate ROADMAP item 4 (cost-predictive admission
+control) builds on:
+
+- :class:`Histogram` / :class:`SloTracker` — fixed-bucket, log-spaced
+  latency histograms per ``domain x stage`` over the request lifecycle
+  stages the PR-4 trace spans already name (``validate -> queue_wait ->
+  batch_wait -> dispatch -> device_run -> decode``). The stages mirror
+  the trace TREE, not a flat chain: ``dispatch`` is the batch-closure
+  envelope, and ``device_run``/``decode`` are sub-stages *inside* it —
+  the additive end-to-end decomposition is validate + queue_wait +
+  batch_wait + dispatch; summing all six double-counts device time. On a
+  compile-bearing batch ``dispatch`` includes the compile wall-clock that
+  ``device_run`` deliberately excludes — a cold class shows up as a
+  dispatch-tail outlier while the device_run tail stays honest. Fixed
+  buckets make the histograms mergeable across replicas and scrapes
+  (Prometheus native ``_bucket``/``_sum``/``_count`` exposition in
+  ``observability.prom``), and the per-stage decomposition turns "p99 is
+  80ms" into "60ms of it is queue_wait" — the difference between adding
+  capacity and tuning ``max_delay_s``. Capture is pure host-side
+  arithmetic (a bisect and three adds per observation): SLO capture
+  on/off adds zero device dispatches and zero compiles by construction.
+- **Shed attribution** — every request the service sheds is counted by
+  *cause* (``rejected`` backpressure, ``too_large``, ``invalid``,
+  ``expired`` pre-dispatch deadline cancellation, ``overrun`` completed
+  past its deadline, ``poisoned`` batch failure) and by the *stage* that
+  consumed its deadline budget (queue_wait vs batch_wait vs dispatch vs
+  device_run) — so a saturated replica shows `expired@queue_wait` while
+  an undersized bucket menu shows `overrun@device_run`, and the fix is
+  readable off /metrics.
+- :func:`detect_knee` — the saturation knee of an offered-load sweep:
+  the highest offered rate the service still serves linearly (throughput
+  tracks offered load AND p99 stays within ``p99_factor`` of the
+  light-load baseline). The knee is the honest "max sustainable QPS as
+  measured" next to the capacity model's predicted one
+  (``observability.capacity``), and ``tools/bench_diff.py --slo`` gates
+  on its trajectory across the committed BENCH series.
+
+Window scoping follows the cost ledger's precedent: producers take a
+:meth:`SloTracker.mark` at run start and export ``snapshot(since=mark)``
+so a sweep record reports *its own* traffic, not the warmup's.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+#: log-spaced histogram upper bounds in SECONDS (1-2.5-5 per decade,
+#: 100 us .. 60 s) — wide enough for a sub-ms validate and a multi-second
+#: cold MoEvA dispatch in one scheme. The implicit +Inf bucket is always
+#: appended at export. Override via ``serving.slo_histogram_buckets``.
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005,
+    0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0,
+)
+
+#: the request lifecycle stages (the PR-4 span names); every histogram
+#: family is keyed (domain, stage). Tree, not chain: device_run/decode
+#: are sub-stages of dispatch — validate + queue_wait + batch_wait +
+#: dispatch is the additive end-to-end decomposition.
+STAGES = (
+    "validate",
+    "queue_wait",
+    "batch_wait",
+    "dispatch",
+    "device_run",
+    "decode",
+)
+
+#: shed-cause taxonomy (docs/DESIGN.md § SLO & capacity): why a request's
+#: answer never reached (or reached late) its caller.
+SHED_CAUSES = (
+    "rejected",  # QueueFull backpressure at submit (never queued)
+    "too_large",  # exceeds the largest bucket (never queued)
+    "invalid",  # failed validation (never queued)
+    "expired",  # deadline passed while queued; cancelled pre-dispatch
+    "overrun",  # completed, but past its deadline (SLO miss, not an error)
+    "poisoned",  # batch execution failed (its own or a batch-mate's rows)
+)
+
+#: keys every ``telemetry.slo`` block must carry (validate_record enforces
+#: them on serving records, mirroring telemetry.cost / telemetry.quality).
+SLO_KEYS = ("stages", "shed", "knee")
+
+
+class Histogram:
+    """Fixed-bucket histogram: counts per upper bound + sum + count.
+
+    Buckets are per-instance-immutable upper bounds (le); observations
+    land in the first bucket whose bound >= value, values above the last
+    bound in the implicit +Inf overflow. Counts are kept per-bucket
+    (non-cumulative) internally and exported cumulative, Prometheus-style,
+    so merged/scraped views stay monotone by construction.
+    """
+
+    def __init__(self, bounds=DEFAULT_LATENCY_BUCKETS):
+        self.bounds = tuple(float(b) for b in bounds)
+        if not self.bounds or list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(
+                f"histogram bounds must be sorted, unique, non-empty: {bounds}"
+            )
+        # one extra slot: the +Inf overflow bucket
+        self._counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float, count: int = 1) -> None:
+        """Fold ``value`` in ``count`` times — how a per-batch duration is
+        weighted by the requests that rode the batch, so every stage in
+        one histogram family stays request-weighted."""
+        v = float(value)
+        self._counts[bisect.bisect_left(self.bounds, v)] += count
+        self.sum += v * count
+        self.count += count
+
+    # -- export --------------------------------------------------------------
+    def state(self) -> tuple:
+        """Raw counters for mark/delta windowing."""
+        return (tuple(self._counts), self.sum, self.count)
+
+    def snapshot(
+        self, since: tuple | None = None, state: tuple | None = None
+    ) -> dict:
+        """JSON-ready cumulative view: ``buckets`` is ``[[le, cumulative]]``
+        ending with ``["+Inf", count]``. With ``since`` (an earlier
+        :meth:`state`), counters are window deltas. ``state`` lets an
+        owner that synchronizes observations itself (SloTracker) pass a
+        consistent :meth:`state` taken under its lock — observe()'s three
+        counter writes are not atomic, and a snapshot racing one would
+        otherwise export a torn view where +Inf != count."""
+        counts, total_sum, total_count = (
+            state if state is not None else self.state()
+        )
+        if since is not None:
+            prev_counts, prev_sum, prev_count = since
+            counts = tuple(c - p for c, p in zip(counts, prev_counts))
+            total_sum -= prev_sum
+            total_count -= prev_count
+        cum, buckets = 0, []
+        for le, c in zip(self.bounds + ("+Inf",), counts):
+            cum += c
+            buckets.append([le, cum])
+        return {
+            "buckets": buckets,
+            "sum": round(total_sum, 6),
+            # n rides next to every quantile consumer: a p99 estimated
+            # over n < 10 observations is the max, not a tail statistic
+            "count": total_count,
+            **self._quantiles(counts, total_count),
+        }
+
+    def _quantiles(self, counts, total: int) -> dict:
+        """Histogram-estimated quantiles (the bucket upper bound containing
+        the rank — conservative, never below the true quantile's bucket).
+        A rank that falls in the +Inf overflow reports the string
+        ``"+Inf"`` (the buckets-key convention): the true quantile is
+        beyond the largest bound, and capping it at that bound — what
+        promql's histogram_quantile does — would dress an unbounded tail
+        as the bucket scheme's max. None when empty; ``n`` always
+        reported so consumers can judge confidence (over tiny n the
+        estimate degenerates to the max)."""
+        out = {"p50": None, "p99": None, "n": total}
+        if total <= 0:
+            return out
+        bounds = self.bounds + (float("inf"),)
+        for key, q in (("p50", 0.50), ("p99", 0.99)):
+            rank = q * total
+            cum = 0
+            for le, c in zip(bounds, counts):
+                cum += c
+                if cum >= rank:
+                    out[key] = le if le != float("inf") else "+Inf"
+                    break
+        return out
+
+
+class SloTracker:
+    """Per-(domain, stage) latency histograms + shed/deadline attribution.
+
+    Thread-safe; ``enabled=False`` turns every method into an immediate
+    return (the on/off toggle the overhead smoke pins — though either way
+    no device work is ever involved). ``mark()``/``snapshot(since=)``
+    scope exports to a window, like ``CostLedger.mark``.
+    """
+
+    def __init__(self, bounds=None, enabled: bool = True):
+        self.bounds = tuple(
+            float(b) for b in (bounds or DEFAULT_LATENCY_BUCKETS)
+        )
+        # fail at construction, not at the first request: a bad
+        # serving.slo_histogram_buckets config must reject the service
+        # boot, not 500 every request once traffic arrives
+        Histogram(self.bounds)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._hists: dict[tuple[str, str], Histogram] = {}
+        self._shed: dict[tuple[str, str, str], int] = {}
+
+    # -- ingestion -----------------------------------------------------------
+    def observe(
+        self, domain: str, stage: str, seconds: float, count: int = 1
+    ) -> None:
+        """Fold one stage latency in, ``count`` times: per-batch stages
+        (device_run, decode) pass the requests that rode the batch so
+        every stage in the family is request-weighted — a family mixing
+        per-request and per-batch populations would break the per-stage
+        decomposition its p99s exist for."""
+        if not self.enabled:
+            return
+        key = (str(domain), str(stage))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(self.bounds)
+            h.observe(seconds, count)
+
+    def shed(self, domain: str, cause: str, stage: str) -> None:
+        """Count one shed/deadline event: ``cause`` from
+        :data:`SHED_CAUSES`, ``stage`` = the stage that consumed the
+        request's deadline budget (or where the shed happened)."""
+        if not self.enabled:
+            return
+        key = (str(domain), str(cause), str(stage))
+        with self._lock:
+            self._shed[key] = self._shed.get(key, 0) + 1
+
+    # -- windowing -----------------------------------------------------------
+    def mark(self) -> dict:
+        """Opaque snapshot for window-scoped exports
+        (``snapshot(since=mark)``)."""
+        with self._lock:
+            return {
+                "hists": {k: h.state() for k, h in self._hists.items()},
+                "shed": dict(self._shed),
+            }
+
+    # -- export --------------------------------------------------------------
+    def shed_block(self, since: dict | None = None) -> dict:
+        prev = (since or {}).get("shed", {})
+        with self._lock:
+            items = {
+                k: n - prev.get(k, 0)
+                for k, n in self._shed.items()
+                if n - prev.get(k, 0) > 0
+            }
+        by_domain: dict = {}
+        for (domain, cause, stage), n in sorted(items.items()):
+            by_domain.setdefault(domain, {}).setdefault(cause, {})[stage] = n
+        return {"total": sum(items.values()), "by_domain": by_domain}
+
+    def snapshot(self, since: dict | None = None) -> dict:
+        prev = (since or {}).get("hists", {})
+        # histogram states are read under the SAME lock observe() mutates
+        # them under — a scrape racing an observation must never export a
+        # torn histogram (+Inf bucket != count breaks the mergeability
+        # contract, and a windowed delta could even go negative)
+        with self._lock:
+            hists = {k: (h, h.state()) for k, h in self._hists.items()}
+        stages: dict = {}
+        for (domain, stage), (h, state) in sorted(hists.items()):
+            snap = h.snapshot(since=prev.get((domain, stage)), state=state)
+            if since is not None and snap["count"] == 0:
+                continue  # stage saw no traffic in the window
+            stages.setdefault(domain, {})[stage] = snap
+        return {
+            "enabled": self.enabled,
+            "bucket_bounds": list(self.bounds),
+            "stages": stages,
+            "shed": self.shed_block(since=since),
+        }
+
+
+def detect_knee(
+    levels,
+    p99_factor: float = 3.0,
+    throughput_floor: float = 0.9,
+) -> dict:
+    """The saturation knee of an offered-load sweep: the highest offered
+    rate still served *linearly*, where linear means (a) achieved request
+    throughput >= ``throughput_floor`` x offered and (b) p99 <=
+    ``p99_factor`` x the lightest level's p99 (the queueing-theory
+    departure point: past the knee p99 grows with queue depth, not with
+    request cost). A level that completed nothing is saturated by
+    definition. ``levels`` are the sweep's per-level dicts
+    (``offered_rps`` / ``throughput_rps`` / ``p99_ms``).
+
+    The throughput test prefers a level's ``completion_ratio`` (offered
+    requests that completed — drain-proof) over ``throughput_rps /
+    offered_rps``: a level's measured duration includes the blocking
+    drain of in-flight requests after the last submission, which reads
+    as a throughput shortfall at high rates even when the service kept
+    pace with every arrival.
+
+    Returns ``{knee_rps, first_saturated_rps, baseline_p99_ms,
+    p99_factor, throughput_floor, levels_n}`` with None knee when no
+    level was linear (the sweep started past saturation) and None
+    first_saturated when every level held (the knee is then a lower
+    bound — the sweep never pushed past it).
+    """
+    usable = sorted(
+        (lv for lv in levels if isinstance(lv.get("offered_rps"), (int, float))),
+        key=lambda lv: lv["offered_rps"],
+    )
+    baseline_p99 = next(
+        (
+            lv["p99_ms"]
+            for lv in usable
+            if isinstance(lv.get("p99_ms"), (int, float))
+        ),
+        None,
+    )
+    knee = None
+    first_saturated = None
+    for lv in usable:
+        p99 = lv.get("p99_ms")
+        ratio = lv.get("completion_ratio")
+        if not isinstance(ratio, (int, float)):
+            thr = lv.get("throughput_rps")
+            ratio = (
+                thr / lv["offered_rps"]
+                if isinstance(thr, (int, float)) and lv["offered_rps"] > 0
+                else None
+            )
+        linear = (
+            isinstance(p99, (int, float))
+            and isinstance(ratio, (int, float))
+            and baseline_p99 is not None
+            and p99 <= p99_factor * baseline_p99
+            and ratio >= throughput_floor
+        )
+        if linear and first_saturated is None:
+            # the knee never advances past a saturated level: a noisy
+            # higher level sneaking back under the bounds must not report
+            # "served linearly up to here" above a rate that already
+            # failed (and inflate the baseline the --slo gate compares to)
+            knee = lv["offered_rps"]
+        elif not linear and first_saturated is None:
+            first_saturated = lv["offered_rps"]
+    return {
+        "knee_rps": knee,
+        "first_saturated_rps": first_saturated,
+        "baseline_p99_ms": baseline_p99,
+        "p99_factor": p99_factor,
+        "throughput_floor": throughput_floor,
+        "levels_n": len(usable),
+    }
+
+
+def slo_block(
+    tracker: SloTracker | None = None,
+    *,
+    since: dict | None = None,
+    knee: dict | None = None,
+    capacity: dict | None = None,
+) -> dict:
+    """Assemble the JSON-ready ``telemetry.slo`` block: per-domain stage
+    histograms, shed attribution, the detected saturation knee, and
+    (optionally) the capacity model's per-domain snapshot. With no
+    tracker the block is empty but schema-valid, mirroring
+    ``quality_block()``."""
+    snap = (
+        tracker.snapshot(since=since)
+        if tracker is not None
+        else {"enabled": False, "bucket_bounds": [], "stages": {},
+              "shed": {"total": 0, "by_domain": {}}}
+    )
+    block = {
+        "enabled": snap["enabled"],
+        "bucket_bounds": snap["bucket_bounds"],
+        "stages": snap["stages"],
+        "shed": snap["shed"],
+        "knee": knee if knee is not None else {},
+    }
+    if capacity is not None:
+        block["capacity"] = capacity
+    return block
+
+
+def validate_slo(block, kind: str = "record") -> dict:
+    """Assert ``block`` is a schema-valid ``telemetry.slo`` block."""
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} record's telemetry.slo must be a dict, got "
+            f"{type(block).__name__}"
+        )
+    missing = [k for k in SLO_KEYS if k not in block]
+    if missing:
+        raise ValueError(
+            f"{kind} record's telemetry.slo is missing keys {missing}: "
+            "assemble it with observability.slo.slo_block so stage "
+            "histograms, shed attribution, and the saturation knee travel "
+            "with every committed serving number"
+        )
+    return block

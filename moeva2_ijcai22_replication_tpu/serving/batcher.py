@@ -103,6 +103,7 @@ class Microbatcher:
         max_delay_s: float = 0.010,
         max_queue_rows: int = 4096,
         metrics=None,
+        slo=None,
         clock: Callable[[], float] | None = None,
         start: bool = True,
     ):
@@ -112,6 +113,11 @@ class Microbatcher:
         self.max_delay_s = float(max_delay_s)
         self.max_queue_rows = int(max_queue_rows)
         self.metrics = metrics
+        #: SLO tracker (``observability.slo.SloTracker`` or None): receives
+        #: per-request stage latencies (queue_wait/batch_wait/dispatch) and
+        #: shed attribution (expired/overrun/poisoned) keyed by the
+        #: ``domain`` each request's meta carries — pure host-side counts
+        self.slo = slo
         self.clock = clock or time.monotonic
         self._queues: dict[Any, _KeyQueue] = {}
         self._rows_total = 0
@@ -230,6 +236,12 @@ class Microbatcher:
             if p.deadline_at is not None and p.deadline_at <= now:
                 if self.metrics:
                     self.metrics.count("timeouts")
+                if self.slo is not None:
+                    # the whole deadline budget went to queueing: the
+                    # request never left the queue
+                    self.slo.shed(
+                        p.meta.get("domain"), "expired", "queue_wait"
+                    )
                 if p.trace is not None:
                     p.trace.event(
                         "cancelled",
@@ -311,8 +323,15 @@ class Microbatcher:
         t0 = self.clock()
         try:
             # every executable compiled under this dispatch records the
-            # bucket it was built for — the cost ledger's serving identity
-            with ledger_context(bucket=int(bucket), batch_requests=len(batch)):
+            # bucket it was built for — the cost ledger's serving identity;
+            # batch_rows is the REAL (pre-padding) row count, what the
+            # capacity model must count as served (the dispatch closure
+            # only ever sees the bucket-padded array)
+            with ledger_context(
+                bucket=int(bucket),
+                batch_requests=len(batch),
+                batch_rows=int(rows_total),
+            ):
                 if bt is None:
                     out = np.asarray(dispatch(x_pad))
                 else:
@@ -333,6 +352,8 @@ class Microbatcher:
                 self.metrics.count("batch_failures")
             err = BatchExecutionError(key, e)
             for p in batch:
+                if self.slo is not None:
+                    self.slo.shed(p.meta.get("domain"), "poisoned", "dispatch")
                 if p.trace is not None:
                     p.trace.event("batch_failed", batch_seq=seq, error=repr(e))
                 p.future.set_exception(err)
@@ -345,8 +366,11 @@ class Microbatcher:
             self.metrics.count("padded_rows", bucket - rows_total)
             self.metrics.observe("batch_occupancy", occupancy)
             self.metrics.observe("dispatch_s", dt)
+        t_done = self.clock()
         off = 0
         for p in batch:
+            queue_wait = max(t_asm - p.enqueued_at, 0.0)
+            batch_wait = max(t0 - t_asm, 0.0)
             meta = dict(
                 p.meta,
                 bucket_size=bucket,
@@ -355,8 +379,26 @@ class Microbatcher:
                 batch_occupancy=occupancy,
                 batch_seq=seq,
                 queued_s=round(t0 - p.enqueued_at, 6),
+                queue_wait_s=round(queue_wait, 6),
+                batch_wait_s=round(batch_wait, 6),
                 dispatch_s=round(dt, 6),
             )
+            if self.slo is not None:
+                domain = p.meta.get("domain")
+                self.slo.observe(domain, "queue_wait", queue_wait)
+                self.slo.observe(domain, "batch_wait", batch_wait)
+                self.slo.observe(domain, "dispatch", dt)
+                if p.deadline_at is not None and p.deadline_at <= t_done:
+                    # completed, but past its deadline: attribute the
+                    # overrun to the stage the deadline instant fell in.
+                    # Never queue_wait — _assemble cancels (sheds as
+                    # "expired") every request whose deadline passed by
+                    # t_asm, so a dispatched request's deadline can only
+                    # have fallen in batch_wait or device time.
+                    stage = (
+                        "batch_wait" if p.deadline_at <= t0 else "device_run"
+                    )
+                    self.slo.shed(domain, "overrun", stage)
             if p.trace is not None and p.trace.enabled:
                 # the request's own waits (batcher clock), then the shared
                 # batch spans re-stamped under the request's trace id — one

@@ -42,9 +42,12 @@ from ..attacks.pgd import ConstrainedPGD, round_ints_toward_initial
 from ..attacks.sharding import describe_mesh
 from ..experiments import common
 from ..observability import (
+    CapacityModel,
+    SloTracker,
     Trace,
     TraceRecorder,
     build_identity,
+    current_ledger_context,
     current_trace,
     device_memory_stats,
     get_ledger,
@@ -53,7 +56,7 @@ from ..observability import (
 )
 from ..utils.config import get_dict_hash
 from ..utils.observability import ServiceMetrics
-from .batcher import BucketMenu, Microbatcher
+from .batcher import BucketMenu, Microbatcher, QueueFull, RequestTooLarge
 
 
 class InvalidRequest(ValueError):
@@ -152,6 +155,9 @@ class AttackService:
         metrics_window: int = 8192,
         recorder=None,
         stream=None,
+        slo_buckets=None,
+        slo_capture: bool = True,
+        capacity_window: int = 256,
         clock: Callable[[], float] | None = None,
         start: bool = True,
     ):
@@ -177,11 +183,23 @@ class AttackService:
         self._build = build_identity(self.domains)
         self.clock = clock or time.monotonic
         self.menu = BucketMenu(bucket_sizes)
+        # SLO substrate (observability.slo): per-(domain, stage) latency
+        # histograms + shed/deadline attribution. Pure host-side counts —
+        # ``slo_capture`` off and on share every compile and dispatch
+        # bit-identically (the tier-1 smoke pins it)
+        self.slo = SloTracker(bounds=slo_buckets, enabled=slo_capture)
+        # ledger-backed capacity model (observability.capacity): fed one
+        # sample per pure-run batch dispatch, published on /healthz. Same
+        # injectable clock as the batcher and every SLO stage — batch
+        # completion timestamps and run_s durations must share one clock
+        # domain or the utilization span mixes bases under a fake clock
+        self.capacity = CapacityModel(window=capacity_window, clock=self.clock)
         self.batcher = Microbatcher(
             self.menu,
             max_delay_s=max_delay_s,
             max_queue_rows=max_queue_rows,
             metrics=self.metrics,
+            slo=self.slo,
             clock=self.clock,
             start=start,
         )
@@ -300,6 +318,8 @@ class AttackService:
                 and engine.num_random_init == 0
                 and not engine.record_loss
             )
+            domain_name = req.domain
+            strategy = req.loss_evaluation
 
             def dispatch(x_batch: np.ndarray) -> np.ndarray:
                 # the ambient per-batch trace the microbatcher installed
@@ -311,17 +331,39 @@ class AttackService:
                 traces0 = engine.trace_count
                 x_scaled = np.asarray(scaler.transform(x_batch))
                 y = np.asarray(surrogate.predict_proba(x_scaled)).argmax(-1)
+                # two clock reads: trace spans stay on perf_counter (the
+                # PR-4 span timebase), SLO/capacity durations ride the
+                # injectable self.clock like every other stage in the
+                # histogram family
                 t0 = time.perf_counter()
+                t0c = self.clock()
                 x_adv = engine.generate(
                     x_scaled, y, eps=eps_run, eps_step=eps_step, max_iter=budget
                 )
-                self.metrics.count("compiles", engine.trace_count - traces0)
+                traced = engine.trace_count - traces0
+                dur = self.clock() - t0c
+                self.metrics.count("compiles", traced)
                 _record_device_span(bt, engine, traces0, t0)
+                self._note_device_run(
+                    domain_name, strategy, budget, engine, traced, dur,
+                    rows=int(x_batch.shape[0]),
+                )
+                td = self.clock()
                 with maybe_span(bt, "decode"):
                     x_adv = np.asarray(scaler.inverse(x_adv))
-                    return round_ints_toward_initial(
+                    out = round_ints_toward_initial(
                         x_adv, x_batch, feature_types
                     )
+                # request-weighted like device_run: every rider of the
+                # batch experienced this decode. No ambient context =
+                # execute_direct oracle, not serving traffic — skip.
+                riders = current_ledger_context().get("batch_requests")
+                if riders is not None:
+                    self.slo.observe(
+                        domain_name, "decode", self.clock() - td,
+                        count=int(riders),
+                    )
+                return out
 
             chunk = None
         else:  # moeva
@@ -339,6 +381,7 @@ class AttackService:
             es_threshold = float(pseudo.get("early_stop_threshold", 0.5))
             es_eps = float(pseudo.get("early_stop_eps", np.inf))
             domain_name = req.domain
+            strategy = req.loss_evaluation
 
             def dispatch(x_batch: np.ndarray) -> np.ndarray:
                 bt = current_trace()
@@ -359,15 +402,24 @@ class AttackService:
                 # the engine's gate progress events (generation index,
                 # success fraction, active set, HBM) land in the batch trace
                 engine.trace = bt
+                # trace spans on perf_counter, SLO/capacity on the
+                # injectable self.clock (see the pgd closure)
                 t0 = time.perf_counter()
+                t0c = self.clock()
                 try:
                     result = engine.generate(x_batch, 1)
                 finally:
                     engine.trace = None
-                self.metrics.count("compiles", engine.trace_count - traces0)
+                traced = engine.trace_count - traces0
+                dur = self.clock() - t0c
+                self.metrics.count("compiles", traced)
                 _record_device_span(
                     bt, engine, traces0, t0,
                     gens_executed=int(result.gens_executed),
+                )
+                self._note_device_run(
+                    domain_name, strategy, budget, engine, traced, dur,
+                    rows=int(x_batch.shape[0]),
                 )
                 # batch quality: engine-judged o-rates/violations over the
                 # (bucket-padded) batch from the fetched objectives — numpy
@@ -383,8 +435,17 @@ class AttackService:
                     ),
                 )
                 self._note_quality(domain_name, sample, bt)
+                td = self.clock()
                 with maybe_span(bt, "decode"):
-                    return np.asarray(result.x_ml)
+                    out = np.asarray(result.x_ml)
+                # see the pgd closure: skip the execute_direct oracle
+                riders = current_ledger_context().get("batch_requests")
+                if riders is not None:
+                    self.slo.observe(
+                        domain_name, "decode", self.clock() - td,
+                        count=int(riders),
+                    )
+                return out
 
             chunk = engine.effective_states_chunk()
 
@@ -426,6 +487,47 @@ class AttackService:
             self._resolved[key] = res
         return res
 
+    def _note_device_run(
+        self, domain: str, strategy: str, budget: int, engine, traced: int,
+        dur: float, *, rows: int,
+    ) -> None:
+        """Feed one batch dispatch into the SLO histograms and the capacity
+        model — pure-run dispatches only: a compile-bearing dispatch's
+        wall-clock is compile time, which would poison both the device_run
+        tail and the sustainable-QPS estimate (compiles are already counted
+        and ledgered separately)."""
+        if traced:
+            return
+        # batch composition the microbatcher pushed for the ledger.
+        # batch_rows is the REAL served row count — the closure's x_batch
+        # is bucket-padded, and publishing padded rows would overstate
+        # capacity by 1/occupancy. No ambient context means the
+        # direct-dispatch oracle (execute_direct, bit-identity checks):
+        # NOT serving traffic — feeding it would skew the latency tails
+        # and the capacity window with padded, un-coalesced dispatches.
+        ctx = current_ledger_context()
+        if "batch_requests" not in ctx:
+            return
+        requests = int(ctx["batch_requests"])
+        # per-batch stage, request-weighted: every rider of the batch
+        # experienced this device run, exactly like the batcher's
+        # per-rider dispatch observations — one population per family
+        self.slo.observe(domain, "device_run", dur, count=requests)
+        counts = getattr(engine, "last_run_dispatch_counts", None)
+        executables = counts or list(
+            getattr(engine, "last_run_executables", ())
+        )
+        self.capacity.note_batch(
+            domain,
+            strategy=strategy,
+            bucket=ctx.get("bucket", rows),
+            budget=int(budget),
+            requests=requests,
+            rows=int(ctx.get("batch_rows", rows)),
+            run_s=dur,
+            flops=get_ledger().flops_for(executables) if executables else None,
+        )
+
     def _validate(self, req: AttackRequest, res: _Resolved) -> np.ndarray:
         x = np.asarray(req.x, dtype=np.float64)
         if x.ndim != 2 or x.shape[0] < 1:
@@ -459,26 +561,52 @@ class AttackService:
             if self.recorder.spans_enabled
             else None
         )
-        with maybe_span(
-            trace, "validate", domain=req.domain, attack=req.attack
-        ):
-            res = self.resolve(req)
-            x = self._validate(req, res)
+        # self.clock, not time.perf_counter: every stage feeding one
+        # histogram family must share the injectable clock domain, or a
+        # fake-clock test (the batcher's start=False pattern) can steer
+        # five stages while the sixth records real wall time
+        t_val = self.clock()
+        try:
+            with maybe_span(
+                trace, "validate", domain=req.domain, attack=req.attack
+            ):
+                res = self.resolve(req)
+                x = self._validate(req, res)
+        except InvalidRequest:
+            # the one shed path reached BEFORE the domain is validated:
+            # key it by the served domain only when it is one, else a
+            # sentinel — a client posting random domain strings must not
+            # mint unbounded (domain, cause, stage) keys / label series
+            domain = (
+                req.domain if req.domain in self.domains else "(unknown)"
+            )
+            self.slo.shed(domain, "invalid", "validate")
+            raise
+        self.slo.observe(req.domain, "validate", self.clock() - t_val)
         t_submit = self.clock()
-        fut = self.batcher.submit(
-            res.key,
-            res.dispatch,
-            x,
-            deadline_s=req.deadline_s,
-            meta=dict(
-                res.meta,
-                request_id=rid,
-                rows=int(x.shape[0]),
-                bit_identical=res.bit_identical,
-                execution=res.execution,
-            ),
-            trace=trace,
-        )
+        try:
+            fut = self.batcher.submit(
+                res.key,
+                res.dispatch,
+                x,
+                deadline_s=req.deadline_s,
+                meta=dict(
+                    res.meta,
+                    request_id=rid,
+                    rows=int(x.shape[0]),
+                    bit_identical=res.bit_identical,
+                    execution=res.execution,
+                ),
+                trace=trace,
+            )
+        except QueueFull:
+            # shed attribution: backpressure consumed the request at the
+            # queue boundary — it never held a slot
+            self.slo.shed(req.domain, "rejected", "queue_wait")
+            raise
+        except RequestTooLarge:
+            self.slo.shed(req.domain, "too_large", "validate")
+            raise
 
         def _done(f):
             latency = self.clock() - t_submit
@@ -615,6 +743,19 @@ class AttackService:
             # domain — a replica whose served success rates drifted shows
             # up here before a caller complains
             "quality": self.quality_snapshot(),
+            # ledger-backed capacity model: predicted FLOPs/request,
+            # achieved FLOP/s, max sustainable QPS, utilization headroom
+            # and calibration error per served domain — the number a load
+            # balancer weights replicas by, and the basis ROADMAP item
+            # 4's admission control prices requests against
+            "capacity": self.capacity.snapshot(),
+            # shed/deadline attribution summary (full histograms stay on
+            # /metrics): a replica shedding under backpressure vs losing
+            # deadlines to device time reads differently here
+            "slo": {
+                "enabled": self.slo.enabled,
+                "shed": self.slo.shed_block(),
+            },
             "caches": {
                 "engine": dict(
                     common.ENGINES.stats(),
@@ -648,6 +789,12 @@ class AttackService:
         # per-domain attack quality: JSON here, labeled
         # moeva2_quality_o_rate{domain,objective} gauges under prom
         snap["quality"] = self.quality_snapshot()
+        # SLO decomposition: per-(domain, stage) latency histograms +
+        # shed attribution — native histogram families
+        # (_bucket/_sum/_count) and shed counters under prom
+        snap["slo"] = self.slo.snapshot()
+        # capacity model: JSON here, labeled capacity gauges under prom
+        snap["capacity"] = self.capacity.snapshot()
         return snap
 
     def close(self):

@@ -12,7 +12,12 @@ per request.
 Pacing is open-loop (submit at the offered rate regardless of completions,
 the standard serving-bench discipline — closed-loop pacing hides queueing
 collapse), with a bounded in-flight window as a safety valve so a
-pathological level cannot accumulate unbounded futures.
+pathological level cannot accumulate unbounded futures. Arrivals default
+to a seeded Poisson process (:func:`arrival_offsets`): a uniform
+metronome never stacks arrivals and so under-measures queueing exactly
+where the knee lives — the committed/gated ``knee_rps`` must be measured
+under the memoryless bursts real independent callers produce.
+``tools/loadgen.py --arrival`` exposes the same two disciplines over HTTP.
 """
 
 from __future__ import annotations
@@ -21,12 +26,14 @@ import time
 from typing import Callable, Sequence
 
 from ..observability import (
+    detect_knee,
     get_ledger,
     quality_block,
+    slo_block,
     telemetry_block,
     validate_record,
 )
-from ..utils.observability import percentile
+from ..utils.observability import arrival_offsets, percentile
 from .batcher import DeadlineExceeded, QueueFull, RequestTooLarge
 from .service import AttackRequest, AttackService
 
@@ -41,9 +48,13 @@ def run_level(
     max_in_flight: int = 1024,
     clock: Callable[[], float] = time.monotonic,
     sleep: Callable[[float], None] = time.sleep,
+    arrival: str = "poisson",
+    seed: int = 42,
 ) -> dict:
     """One offered-load level: submit ``n_requests`` paced at
-    ``offered_rps``, wait for completion, report the level record."""
+    ``offered_rps`` under the ``arrival`` process (seeded Poisson by
+    default — see :func:`arrival_offsets`), wait for completion, report
+    the level record."""
     latencies: list[float] = []
     occupancies: list[float] = []
     rows_done = 0
@@ -72,16 +83,24 @@ def run_level(
             rows_done += int(meta["rows"])
         in_flight[:] = remaining
 
+    offsets = arrival_offsets(arrival, offered_rps, n_requests, seed)
     t_start = clock()
-    period = 1.0 / offered_rps if offered_rps > 0 else 0.0
     for i in range(n_requests):
-        target = t_start + i * period
+        target = t_start + offsets[i]
         delta = target - clock()
         if delta > 0:
             sleep(delta)
         if len(in_flight) >= max_in_flight:
             reap(block=True)
-        t_sub = clock()
+        # latency origin is the SCHEDULED arrival, not the actual submit
+        # instant: when the submit loop slips behind schedule (contended
+        # host, in-flight reap stall) the backlog wait is latency the
+        # offered load experienced — measuring from the submit instant
+        # would silently drop it and overstate the knee (the same
+        # coordinated-omission trap tools/loadgen.py charges from its
+        # schedule to avoid). Unpaced (rate 0) has no schedule: measure
+        # from submit, like loadgen's unpaced throughput-probe mode.
+        t_sub = target if offered_rps > 0 else clock()
         try:
             fut = service.submit(make_request(i))
         except (QueueFull, RequestTooLarge):
@@ -101,6 +120,10 @@ def run_level(
     n_ok = len(latencies)
     return {
         "offered_rps": offered_rps,
+        # the arrival process the level was measured under: knees from
+        # uniform-metronome levels are optimistic vs bursty traffic, so
+        # the record says which discipline produced its numbers
+        "arrival": arrival,
         "n_requests": n_requests,
         "completed": n_ok,
         "rejected": rejected,
@@ -109,10 +132,21 @@ def run_level(
         "duration_s": round(duration, 3),
         "throughput_rps": round(n_ok / duration, 2),
         "throughput_rows_s": round(rows_done / duration, 1),
+        # the knee detector's drain-proof linearity basis: duration (and
+        # so throughput_rps) includes the blocking drain of in-flight
+        # requests after the last submission, which reads as a throughput
+        # shortfall at high rates even when the service kept pace with
+        # every arrival; the fraction of offered requests that completed
+        # has no such tail
+        "completion_ratio": round(n_ok / n_requests, 4) if n_requests else None,
         # None, not NaN, when a level completed nothing: the record is
         # strict JSON (RFC 8259 has no NaN) for jq and cross-language readers
         "p50_ms": round(percentile(lat_sorted, 0.50) * 1e3, 2) if n_ok else None,
         "p99_ms": round(percentile(lat_sorted, 0.99) * 1e3, 2) if n_ok else None,
+        # the quantiles' sample size, annotated next to them: nearest-rank
+        # p99 over n < 10 silently reports the max — consumers judge
+        # confidence from n, not from the quantile alone
+        "quantiles_n": n_ok,
         "mean_batch_occupancy": round(
             sum(occupancies) / len(occupancies), 4
         )
@@ -133,10 +167,17 @@ def offered_load_sweep(
     # cost window: the record's telemetry.cost covers the sweep's own
     # dispatches (warmup compiles paid before this call stay out)
     ledger_mark = get_ledger().mark()
+    # SLO window, same discipline: stage histograms and shed counts in
+    # the record cover the sweep's traffic, not the warmup's
+    slo_mark = service.slo.mark()
     levels = [
         run_level(service, make_request, rps, n_requests, **kw)
         for rps in offered_rps_levels
     ]
+    # saturation knee: the highest offered rate still served linearly —
+    # the record's measured max-sustainable-QPS, which bench_diff --slo
+    # gates across the committed series
+    knee = detect_knee(levels)
     snap = service.metrics_snapshot()
     return validate_record(
         {
@@ -163,6 +204,16 @@ def offered_load_sweep(
                 quality=dict(
                     quality_block(judged="engine"),
                     **service.quality_snapshot(),
+                ),
+                # SLO block: per-stage latency histograms, shed/deadline
+                # attribution, the detected knee, and the capacity model's
+                # per-domain view — required on serving records by
+                # validate_record, like telemetry.cost/quality
+                slo=slo_block(
+                    service.slo,
+                    since=slo_mark,
+                    knee=knee,
+                    capacity=service.capacity.snapshot(),
                 ),
             ),
         },

@@ -81,11 +81,46 @@ class PhaseTimer:
 def percentile(sorted_vals, q: float) -> float:
     """Nearest-rank percentile on an already-sorted sample list (NaN when
     empty) — the one quantile definition shared by ServiceMetrics, the
-    offered-load sweep, and the loadgen CLI."""
+    offered-load sweep, and the loadgen CLI.
+
+    Confidence caveat: nearest-rank p99 over n < 10 samples IS the max
+    (rank rounds to the last element) — a tail statistic in name only.
+    Every exporter therefore annotates the sample size next to the
+    quantile (``window_n`` in metrics snapshots, ``quantiles_n`` in
+    sweep levels and loadgen summaries) so consumers judge confidence
+    instead of trusting a max dressed as a p99."""
     if not sorted_vals:
         return float("nan")
     i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
     return sorted_vals[i]
+
+
+def arrival_offsets(
+    arrival: str, rps: float, n: int, seed: int = 42
+) -> list[float]:
+    """Submission-time offsets (seconds from start) for ``n`` requests at
+    ``rps`` — the one arrival-process definition shared by the in-process
+    offered-load sweep and the loadgen CLI (so HTTP and in-process knees
+    are comparable). Computed up front, which is what makes the pacing
+    open-loop: a struggling server cannot slow the offered load down.
+    ``uniform`` is exact 1/rps spacing (a metronome; never stacks
+    arrivals, flatters the queue near saturation); ``poisson`` draws
+    seeded exponential inter-arrival gaps at the same mean rate — the
+    memoryless bursts real independent callers produce, and the arrival
+    process saturation/knee measurement requires. Lives here (not in
+    ``serving.sweep``) so the loadgen client can import it without the
+    engine stack."""
+    period = 1.0 / rps if rps > 0 else 0.0
+    if arrival == "poisson" and period > 0:
+        import random
+
+        rng = random.Random(seed)
+        offsets, t = [], 0.0
+        for _ in range(n):
+            offsets.append(t)
+            t += rng.expovariate(rps)
+        return offsets
+    return [i * period for i in range(n)]
 
 
 class ServiceMetrics:
@@ -142,8 +177,10 @@ class ServiceMetrics:
 
     def snapshot(self) -> dict:
         """JSON-ready state: counters, gauges, and per-stream
-        ``{count, mean, p50, p99, max}`` (quantiles over the recent
-        window, count/mean over the full history)."""
+        ``{count, mean, p50, p99, window_n, max}`` (quantiles over the
+        recent window — ``window_n`` is the sample count they were
+        computed over, annotated so a p99 over a tiny window reads as
+        the max it is; count/mean over the full history)."""
         with self._lock:
             counters = dict(self.counters)
             gauges = dict(self.gauges)
@@ -157,6 +194,7 @@ class ServiceMetrics:
                 "mean": (s / n) if n else None,
                 "p50": percentile(vals, 0.50) if vals else None,
                 "p99": percentile(vals, 0.99) if vals else None,
+                "window_n": len(vals),
                 "max": vals[-1] if vals else None,
             }
         return out

@@ -850,14 +850,18 @@ class TestBenchDiffRepoCheck:
     def test_committed_series_passes(self):
         """The repo check tier-1 runs: regressions in a future PR's bench
         record fail here. Committed records predate the ledger, so this
-        exercises the raw/shape fallback path too."""
+        exercises the raw/shape fallback path too. ``--slo`` arms the
+        serving SLO gate (knee QPS + p99-at-fixed-load) alongside the
+        perf+quality watchdog — pre-SLO records skip as baselines, so the
+        gate goes live with the first record that carries
+        ``telemetry.slo`` and every later record is held to it."""
         import glob as _glob
 
         series = sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json")))
         assert len(series) >= 2
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
-             "--check", *series],
+             "--check", "--slo", *series],
             capture_output=True,
             text=True,
             cwd=REPO,

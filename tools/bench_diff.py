@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Perf+quality watchdog: diff bench records, normalized by ledger cost.
+"""Perf+quality+SLO watchdog: diff bench records, normalized by ledger cost.
 
 The committed ``BENCH_r*.json`` series is the repo's performance AND
 correctness trajectory; this tool turns it into an enforced contract. It
@@ -12,6 +12,7 @@ bad direction — runnable standalone or as the repo check wired into tier-1
     python tools/bench_diff.py --check BENCH_r*.json           # whole series
     python tools/bench_diff.py --check                         # globs BENCH_r*.json
     python tools/bench_diff.py --check --threshold 0.4 ...
+    python tools/bench_diff.py --check --slo ...               # + serving SLO gate
     python tools/bench_diff.py --check --json ...              # + CI JSON line
 
 Quality metrics: records carrying a ``telemetry.quality`` (and/or
@@ -41,6 +42,22 @@ regression, and one that halves the shape cannot hide one. Records
 predating the ledger fall back to a raw comparison (the bench defaults
 have been stable) with the basis named in the output line.
 
+SLO metrics (``--slo``): serving records carrying a ``telemetry.slo``
+block expose the offered-load sweep's saturation knee
+(``knee_rps`` — the highest offered rate still served linearly) and the
+per-level p99 at each fixed offered load; with ``--slo``, a knee-QPS
+drop or a p99-at-fixed-load increase past ``--slo-threshold`` (relative;
+default 0.5 — client-observed p99 on a shared CI host jitters far more
+than wall-clock totals do, so the latency gate sits wider than the perf
+gate) fails the check — and so does a baseline-tracked metric DEGRADING
+to null (knee_rps None = no level served linearly; a level p99 of None
+= it completed nothing): worse than any number, never a skip. Levels
+are compared only at identical offered
+rates (a reshaped sweep ladder skips, it doesn't fail), but a latest
+serving record with NO slo block while any baseline carries one fails —
+losing SLO capture would disarm this gate exactly like losing quality
+capture disarms that one. Pre-SLO records (r01–r06) skip as baselines.
+
 Records may be bare bench JSON or the committed driver wrapper
 ``{"n", "cmd", "rc", "parsed"}``; wrappers with a non-zero rc or an
 empty payload are skipped (a crashed bench is not evidence of a
@@ -63,6 +80,10 @@ DEFAULT_THRESHOLD = 0.25
 #: absolute interior-success-rate drop that fails the check (see module
 #: docstring for the noise-floor rationale).
 DEFAULT_QUALITY_THRESHOLD = 0.10
+
+#: relative SLO regression (knee-QPS drop / fixed-load-p99 increase)
+#: that fails the check under --slo (see module docstring).
+DEFAULT_SLO_THRESHOLD = 0.5
 
 #: o-columns tracked at each interior budget: o2 (misclassified) and o7
 #: (the full constrained-adversarial criterion) — the two the round-5
@@ -216,10 +237,66 @@ def _quality_points(rec: dict) -> dict[str, tuple[float, int | None]]:
     return out
 
 
+def _slo_points(rec: dict) -> dict[str, tuple[float, bool]]:
+    """Every SLO metric this record's serving block exposes:
+    ``{name: (value, lower_is_better)}`` — the sweep's saturation knee
+    (higher is better) plus the client p99 at each offered-load level
+    (lower is better, compared only at IDENTICAL offered rates; a
+    reshaped ladder is "not comparable", never a fake regression). Both
+    the standalone ``bench.py --serving`` wrapper and the full bench
+    record keep the sweep under a ``serving`` key."""
+    out: dict[str, tuple[float, bool]] = {}
+    serving = rec.get("serving")
+    if not isinstance(serving, dict):
+        return out
+    slo = _get(serving, "telemetry.slo")
+    if not isinstance(slo, dict):
+        # pre-SLO record: its levels DO carry p99 numbers, but they were
+        # measured without the SLO discipline (no knee, no shed
+        # attribution, warmup mixed in) — the skip-as-baseline convention
+        # keys off the telemetry.slo block, like quality keys off
+        # telemetry.quality
+        return out
+    knee = (slo.get("knee") or {}).get("knee_rps")
+    if isinstance(knee, (int, float)):
+        out["serving.slo.knee_rps"] = (float(knee), False)
+    for lv in serving.get("levels") or []:
+        rps, p99 = lv.get("offered_rps"), lv.get("p99_ms")
+        if isinstance(rps, (int, float)) and isinstance(p99, (int, float)):
+            out[f"serving.p99_ms@{rps:g}rps"] = (float(p99), True)
+    return out
+
+
+def _slo_degraded(rec: dict) -> set[str]:
+    """SLO metric names whose value DEGRADED TO NOTHING in ``rec`` —
+    worse than any number, not 'absent': a knee of None means no level
+    served linearly, a level with a null p99 completed zero requests.
+    These must fail against a numeric baseline, never silently vanish
+    from the comparison (which only walks the latest record's numeric
+    points). Only meaningful for records that carry telemetry.slo."""
+    serving = rec.get("serving")
+    if not isinstance(serving, dict):
+        return set()
+    slo = _get(serving, "telemetry.slo")
+    if not isinstance(slo, dict):
+        return set()
+    degraded = set()
+    knee = slo.get("knee") or {}
+    if "knee_rps" in knee and knee["knee_rps"] is None:
+        degraded.add("serving.slo.knee_rps")
+    for lv in serving.get("levels") or []:
+        rps = lv.get("offered_rps")
+        if isinstance(rps, (int, float)) and lv.get("p99_ms") is None:
+            degraded.add(f"serving.p99_ms@{rps:g}rps")
+    return degraded
+
+
 def diff_series(
     records: list[tuple[str, dict]],
     threshold: float,
     quality_threshold: float = DEFAULT_QUALITY_THRESHOLD,
+    slo: bool = False,
+    slo_threshold: float = DEFAULT_SLO_THRESHOLD,
 ) -> tuple[list[str], bool, list[dict]]:
     """Compare the last record pairwise against every earlier one, each
     pair in the strongest normalization basis BOTH sides support (ledger
@@ -375,6 +452,111 @@ def diff_series(
                 "verdict": "regression" if bad else "ok",
             }
         )
+
+    # -- SLO: knee QPS + p99-at-fixed-load, opt-in via --slo --------------
+    if slo:
+        new_slo = _slo_points(latest)
+        new_degraded = _slo_degraded(latest)
+        old_slo: dict[str, list[tuple[str, float]]] = {}
+        any_baseline_slo = False
+        for path, rec in earlier:
+            pts = _slo_points(rec)
+            any_baseline_slo |= bool(pts)
+            for name, (v, _) in pts.items():
+                old_slo.setdefault(name, []).append((path, v))
+        # a baseline-tracked metric that DEGRADED to nothing in the
+        # latest record (knee None = no level served linearly; a level's
+        # p99 null = it completed zero requests) is the worst possible
+        # value, not a skip — the comparison loop below only walks the
+        # latest record's numeric points and would never see it
+        for name in sorted(set(old_slo) & new_degraded):
+            regressed = True
+            path = old_slo[name][0][0]
+            lines.append(
+                f"  {name}: numeric in {path} but degraded to null in "
+                f"{latest_path} — nothing served at this point  "
+                "** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "slo",
+                    "baseline": path,
+                    "verdict": "regression",
+                    "reason": "degraded_to_null",
+                }
+            )
+        if not any_baseline_slo and not new_slo and not new_degraded:
+            lines.append(
+                f"  slo: no telemetry.slo metrics in {latest_path} or any "
+                "baseline — skipped"
+            )
+            entries.append(
+                {"metric": "slo", "verdict": "skipped", "reason": "absent"}
+            )
+        elif any_baseline_slo and not new_slo and not new_degraded:
+            # block-level capture loss: a baseline measured its knee and
+            # p99 ladder, the latest record measured nothing — the gate
+            # must not be disarmable by dropping the measurement
+            regressed = True
+            lines.append(
+                f"  slo: baselines carry telemetry.slo but {latest_path} "
+                "does not — SLO capture was lost  ** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": "slo",
+                    "kind": "slo",
+                    "verdict": "regression",
+                    "reason": "slo_capture_lost",
+                }
+            )
+        for name in sorted(new_slo):
+            new_v, lower_better = new_slo[name]
+            olds = old_slo.get(name, [])
+            if not olds:
+                lines.append(
+                    f"  {name}: no comparable earlier record — skipped"
+                )
+                entries.append(
+                    {"metric": name, "verdict": "skipped",
+                     "reason": "no_baseline"}
+                )
+                continue
+            pairs = [
+                (
+                    (new_v - old_v) / old_v
+                    if lower_better
+                    else (old_v - new_v) / old_v,
+                    path,
+                    old_v,
+                )
+                for path, old_v in olds
+                if old_v != 0
+            ]
+            if not pairs:
+                continue
+            rel, path, old_v = max(pairs, key=lambda t: t[0])
+            bad = rel > slo_threshold
+            regressed |= bad
+            direction = "worse" if rel > 0 else "better"
+            lines.append(
+                f"  {name}: {new_v:.6g} vs best {old_v:.6g} ({path}) "
+                f"[slo] -> {abs(rel) * 100:.1f}% {direction}"
+                + ("  ** REGRESSION **" if bad else "")
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "slo",
+                    "basis": "relative",
+                    "baseline": path,
+                    "old": old_v,
+                    "new": new_v,
+                    "delta_rel": rel,
+                    "verdict": "regression" if bad else "ok",
+                }
+            )
     return lines, regressed, entries
 
 
@@ -405,6 +587,20 @@ def main(argv=None) -> int:
         default=DEFAULT_QUALITY_THRESHOLD,
         help="absolute interior-success-rate drop that fails "
         f"(default {DEFAULT_QUALITY_THRESHOLD})",
+    )
+    parser.add_argument(
+        "--slo",
+        action="store_true",
+        help="also gate the serving SLO metrics: saturation-knee QPS and "
+        "p99 at each fixed offered load (pre-SLO records skip as "
+        "baselines; a latest record that LOST slo capture fails)",
+    )
+    parser.add_argument(
+        "--slo-threshold",
+        type=float,
+        default=DEFAULT_SLO_THRESHOLD,
+        help="relative SLO regression that fails under --slo "
+        f"(default {DEFAULT_SLO_THRESHOLD})",
     )
     parser.add_argument(
         "--json",
@@ -450,7 +646,11 @@ def main(argv=None) -> int:
         f"{args.quality_threshold:g} abs"
     )
     lines, regressed, entries = diff_series(
-        records, args.threshold, args.quality_threshold
+        records,
+        args.threshold,
+        args.quality_threshold,
+        slo=args.slo,
+        slo_threshold=args.slo_threshold,
     )
     print("\n".join(lines))
     if regressed:
@@ -467,6 +667,8 @@ def main(argv=None) -> int:
                     "baselines": [p for p, _ in records[:-1]],
                     "threshold": args.threshold,
                     "quality_threshold": args.quality_threshold,
+                    "slo": args.slo,
+                    "slo_threshold": args.slo_threshold,
                     "regressed": regressed,
                     "metrics": entries,
                 }

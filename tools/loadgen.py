@@ -3,12 +3,33 @@
 Open-loop offered load against ``POST /attack``: N requests with
 mixed-size constraint-valid synthetic rows, paced at ``--rps`` (0 = as
 fast as the concurrency allows), issued from a thread pool. Prints one
-JSON summary line: achieved throughput, latency quantiles, and the
-status breakdown (ok / rejected-429 / timeout-504 / error) — the
-client-side mirror of the server's ``/metrics`` record.
+JSON summary line: achieved throughput, latency quantiles (with their
+sample count, ``quantiles_n`` — a p99 over a handful of requests is the
+max, not a tail), and the status breakdown (ok / rejected-429 /
+timeout-504 / error) — the client-side mirror of the server's
+``/metrics`` record.
+
+Arrival process (``--arrival``): ``uniform`` submits at exact 1/rps
+intervals — a metronome no real traffic resembles, which never stacks
+arrivals and so under-measures queueing near saturation; ``poisson``
+draws exponential inter-arrival gaps at the same mean rate (seeded,
+reproducible), the memoryless arrivals real independent callers
+produce, whose natural bursts exercise the queue exactly where SLOs
+break. Both are OPEN-loop: submission times are fixed up front and
+never wait for completions, and paced runs (``--rps > 0``) measure
+every latency sample from the request's SCHEDULED arrival — including
+any wait for a free worker thread when all ``--concurrency`` slots are
+busy — so a slow server inflates measured latency, not the offered
+load (the coordinated-omission trap closed-loop generators fall into).
+Unpaced runs (``--rps 0``) have no arrival schedule and measure from
+send: a throughput probe, not a latency-at-offered-load measurement.
+Use ``poisson`` with an explicit ``--rps`` for saturation/knee
+measurements, with ``--concurrency`` high enough that in-flight
+requests rarely saturate it.
 
     python tools/loadgen.py --url http://127.0.0.1:8787 --domain lcld \
-        --requests 64 --concurrency 8 --rows-min 1 --rows-max 13
+        --requests 64 --concurrency 8 --rows-min 1 --rows-max 13 \
+        --rps 50 --arrival poisson
 """
 
 from __future__ import annotations
@@ -24,7 +45,10 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from moeva2_ijcai22_replication_tpu.utils.observability import percentile  # noqa: E402
+from moeva2_ijcai22_replication_tpu.utils.observability import (  # noqa: E402
+    arrival_offsets,  # canonical: the in-process sweep paces with the
+    percentile,  # same disciplines, so HTTP and in-process knees compare
+)
 
 
 def make_rows(domain_cfg: dict, n_rows: int, seed: int):
@@ -50,12 +74,19 @@ def make_rows(domain_cfg: dict, n_rows: int, seed: int):
     return x[idx].tolist()
 
 
-def post_attack(url: str, payload: dict, timeout: float):
+def post_attack(url: str, payload: dict, timeout: float, t0: float | None = None):
+    """POST one attack; latency is measured from ``t0`` when given — the
+    request's SCHEDULED arrival time, so time spent waiting for a free
+    worker thread (all ``--concurrency`` slots busy) is charged to the
+    request like any other queueing. Measuring from the moment the worker
+    picks the task up would hide exactly the wait coordinated omission is
+    about."""
     body = json.dumps(payload).encode()
     req = urllib.request.Request(
         f"{url}/attack", data=body, headers={"Content-Type": "application/json"}
     )
-    t0 = time.monotonic()
+    if t0 is None:
+        t0 = time.monotonic()
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             json.loads(resp.read())
@@ -81,6 +112,16 @@ def run(args) -> dict:
         n: make_rows(domain_cfg, n, seed=1000 + n) for n in sorted(set(sizes))
     }
 
+    paced = args.rps > 0
+    if args.arrival == "poisson" and not paced:
+        print(
+            "loadgen: --arrival poisson needs --rps > 0; unpaced run "
+            "submits everything at once",
+            file=sys.stderr,
+        )
+    offsets = arrival_offsets(args.arrival, args.rps, args.requests, args.seed)
+    t_start = time.monotonic()
+
     def one(i: int):
         payload = {
             "domain": args.domain,
@@ -90,16 +131,23 @@ def run(args) -> dict:
             "loss_evaluation": args.loss_evaluation,
             "request_id": f"loadgen-{i}",
         }
-        return post_attack(args.url, payload, args.timeout)
-
-    period = 1.0 / args.rps if args.rps > 0 else 0.0
-    t_start = time.monotonic()
+        # PACED runs charge latency from the SCHEDULED arrival, not when a
+        # worker thread frees up: executor-queue wait is queueing the
+        # client observed, and excluding it would reintroduce coordinated
+        # omission through the thread pool. Unpaced (--rps 0) has no
+        # schedule — every offset is 0, and charging from t_start would
+        # report the run's makespan as every request's latency — so it
+        # measures from send: a throughput probe, not an offered-load
+        # latency measurement.
+        return post_attack(
+            args.url, payload, args.timeout,
+            t0=t_start + offsets[i] if paced else None,
+        )
     results = []
     with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
         futs = []
         for i in range(args.requests):
-            target = t_start + i * period
-            delay = target - time.monotonic()
+            delay = t_start + offsets[i] - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
             futs.append(pool.submit(one, i))
@@ -116,10 +164,12 @@ def run(args) -> dict:
         "requests": args.requests,
         "concurrency": args.concurrency,
         "offered_rps": args.rps,
+        "arrival": args.arrival,
         "duration_s": round(duration, 3),
         "throughput_rps": round(len(ok_lat) / duration, 2),
         "p50_ms": round(percentile(ok_lat, 0.50) * 1e3, 2) if ok_lat else None,
         "p99_ms": round(percentile(ok_lat, 0.99) * 1e3, 2) if ok_lat else None,
+        "quantiles_n": len(ok_lat),
         "statuses": statuses,
     }
 
@@ -134,6 +184,21 @@ def main(argv=None) -> int:
     parser.add_argument("--concurrency", type=int, default=8)
     parser.add_argument("--rps", type=float, default=0.0,
                         help="offered request rate; 0 = unpaced")
+    parser.add_argument("--arrival", choices=("uniform", "poisson"),
+                        default="uniform",
+                        help="arrival process at --rps: 'uniform' = exact "
+                        "1/rps spacing (a metronome; never stacks arrivals, "
+                        "flatters the queue near saturation); 'poisson' = "
+                        "seeded exponential inter-arrival gaps at the same "
+                        "mean rate (real independent-caller bursts — use "
+                        "for saturation/knee measurement). Both are "
+                        "open-loop: submission never waits on completions, "
+                        "and latency is measured from each request's "
+                        "scheduled arrival — including any wait for a free "
+                        "worker slot — so queueing is never hidden "
+                        "(no coordinated omission)")
+    parser.add_argument("--seed", type=int, default=42,
+                        help="RNG seed for --arrival poisson")
     parser.add_argument("--rows-min", type=int, default=1)
     parser.add_argument("--rows-max", type=int, default=13)
     parser.add_argument("--eps", type=float, default=0.2)

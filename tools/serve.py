@@ -62,6 +62,8 @@ def main(argv=None) -> int:
         seed=srv_cfg.get("seed", 42),
         metrics_window=srv_cfg.get("metrics_window", 8192),
         recorder=recorder,
+        slo_buckets=srv_cfg.get("slo_histogram_buckets"),
+        capacity_window=srv_cfg.get("capacity_window", 256),
     )
     host = args.host or srv_cfg.get("host", "127.0.0.1")
     port = args.port if args.port is not None else srv_cfg.get("port", 8787)

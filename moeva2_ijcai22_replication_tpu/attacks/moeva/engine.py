@@ -32,8 +32,9 @@ from ...core.constraints import ConstraintSet
 from ...core.norms import is_l2, lp_distance, validate_norm
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
-from ...observability import device_memory_stats
+from ...observability import all_device_memory_stats, device_memory_stats
 from ...observability.ledger import LedgeredJit, get_ledger
+from ...observability.mesh import get_mesh_capture
 from ...observability.quality import merge_chunk_quality, sample_from_per_state
 from ..objective import engine_quality_stats
 from .initialisation import lp_ratio_init, tile_init
@@ -283,12 +284,22 @@ class Moeva2:
         self._jit_init = None
         self._jit_segment = None
         self._jit_success = None
+        #: success-gate scalar args (threshold, ε) placed once per engine:
+        #: on a mesh they must be explicitly replicated — a device-0 scalar
+        #: would be implicitly respread across the mesh at every gate
+        #: dispatch (tools/shard_lint.py's transfer-guard rule trips on it).
+        self._gate_scalars = None
         #: number of program (re)traces across init + segment — one per
         #: distinct executable (grid observability reads the delta per point).
         self.trace_count = 0
         #: (entry, compile_s) per ledger dispatch of the current ``generate``
         #: — drained by :meth:`_attribute_run` into roofline run seconds.
         self._dispatch_log: list = []
+        #: (per-device live-row counts, generation steps) per segment
+        #: dispatch of the current ``generate`` — drained by
+        #: :meth:`_attribute_run` into the mesh balance capture (per-device
+        #: run-second skew at the existing sync points, never a new one).
+        self._balance_log: list = []
         #: ledger keys (and per-key dispatch counts) the most recent
         #: ``generate`` dispatched — serving joins them with its
         #: device_run span for per-span roofline attribution.
@@ -323,6 +334,7 @@ class Moeva2:
         timing exists only at this aggregate level (documented as
         approximate in DESIGN § cost ledger)."""
         log, self._dispatch_log = self._dispatch_log, []
+        balance_log, self._balance_log = self._balance_log, []
         entries = [e for e, _ in log if e is not None]
         self.last_run_executables = list(
             dict.fromkeys(e.key for e in entries)
@@ -331,9 +343,24 @@ class Moeva2:
         for e in entries:
             counts[e.key] = counts.get(e.key, 0) + 1
         self.last_run_dispatch_counts = counts
+        run_total = max(elapsed - sum(c for _, c in log), 0.0)
+        # per-device balance: split the run seconds across the logged
+        # segment windows by generation count, attributing each window's
+        # seconds to devices in proportion to their live-row share — pads
+        # and parked rows are wall-clock without useful work, which is
+        # exactly the skew the telemetry.mesh balance ratio surfaces.
+        # Before the ledger early-out: balance needs only the wall-clock
+        # and the window log, so a cost_ledger-off run keeps its mesh
+        # telemetry (the two knobs are independent)
+        total_gens = sum(g for _, g in balance_log)
+        if total_gens > 0 and run_total > 0:
+            capture = get_mesh_capture()
+            for rows, gens in balance_log:
+                capture.record_balance(
+                    rows, run_total * gens / total_gens
+                )
         if not entries:
             return
-        run_total = max(elapsed - sum(c for _, c in log), 0.0)
         weights = [e.flops for e in entries]
         if not all(weights):
             weights = [1.0] * len(entries)
@@ -568,6 +595,7 @@ class Moeva2:
 
         chunk = self.effective_states_chunk()
         self._dispatch_log = []
+        self._balance_log = []
         t0 = time.perf_counter()
         try:
             if chunk and s > chunk:
@@ -688,6 +716,18 @@ class Moeva2:
         tr = self.trace
         if tr is None or not getattr(tr, "enabled", False):
             return
+        if self.mesh is not None and self.mesh.size > 1:
+            # all mesh devices, not device 0: the max is the watermark a
+            # capacity planner sizes for, the per-device list is where an
+            # imbalance (one shard's archive blowing up) shows first
+            stats = all_device_memory_stats(list(self.mesh.devices.flat))
+            tr.event(
+                name,
+                hbm=(stats or {}).get("max"),
+                hbm_devices=(stats or {}).get("per_device"),
+                **attrs,
+            )
+            return
         dev = self.mesh.devices.flat[0] if self.mesh is not None else None
         tr.event(name, hbm=device_memory_stats(dev), **attrs)
 
@@ -737,13 +777,19 @@ class Moeva2:
             )
         # early_stop_eps is a distance in normalised feature space; the
         # carried f2 objective divides L2 distances by sqrt(D)
-        eps = float(self.early_stop_eps) / self._f2_scale
-        return self._jit_success(
-            carry[1],
-            carry[3],
-            jnp.asarray(self.early_stop_threshold, self.dtype),
-            jnp.asarray(eps, self.dtype),
-        )
+        if self._gate_scalars is None:
+            eps = float(self.early_stop_eps) / self._f2_scale
+            scalars = (
+                jnp.asarray(self.early_stop_threshold, self.dtype),
+                jnp.asarray(eps, self.dtype),
+            )
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self.mesh, P())
+                scalars = tuple(jax.device_put(a, repl) for a in scalars)
+            self._gate_scalars = scalars
+        return self._jit_success(carry[1], carry[3], *self._gate_scalars)
 
     def _take_carry(self, carry, sel: np.ndarray):
         """Repack the carry's states axis to ``sel`` (device-side gather —
@@ -990,6 +1036,21 @@ class Moeva2:
             )
             done += length
             gens_executed += length
+            if (
+                self.mesh is not None
+                and self.mesh.size > 1
+                and len(row_live) % self.mesh.size == 0
+            ):
+                # per-device live rows of this segment window (the states
+                # axis shards contiguously over the 1-D mesh, so ordinal d
+                # owns rows [d*k, (d+1)*k)) — host-side bookkeeping on a
+                # mask already in hand, drained by _attribute_run
+                live = (
+                    row_live.reshape(self.mesh.size, -1)
+                    .sum(axis=1)
+                    .tolist()
+                )
+                self._balance_log.append((live, length))
 
             def flush_pending():
                 # fetch the in-flight chunk; with checkpointing it also

@@ -448,10 +448,22 @@ class ConstrainedPGD:
         self.last_run_dispatch_counts = (
             {entry.key: 1} if entry is not None else {}
         )
+        run_s = (
+            time.perf_counter() - t0 - self._jit_attack.last_call_compile_s
+        )
         if entry is not None:
-            get_ledger().add_run_seconds(
-                entry.key,
-                time.perf_counter() - t0 - self._jit_attack.last_call_compile_s,
+            get_ledger().add_run_seconds(entry.key, run_s)
+        if self.mesh is not None and self.mesh.size > 1:
+            # per-device balance at the same sync point: PGD runs every
+            # row to the full budget, so the engine's view is uniform —
+            # rows per device is the padded batch split evenly (runners
+            # pad to a mesh multiple before dispatch; pad rows are wasted
+            # lockstep work but the engine cannot tell them apart)
+            from ...observability.mesh import get_mesh_capture
+
+            n = self.mesh.size
+            get_mesh_capture().record_balance(
+                [x_scaled.shape[0] / n] * n, run_s
             )
         return x_out
 

@@ -6,6 +6,12 @@ zero collectives in the hot loop. This module owns the divisibility contract
 and the replicate/shard placements so the engines cannot drift; runners that
 face data-dependent candidate counts pad to a mesh multiple with
 :func:`..experiments.common.pad_states` and trim afterwards.
+
+The zero-collective contract is machine-checked: ``tools/shard_lint.py``
+compiles the hot attack programs on an emulated 8-device mesh and fails on
+any hot-loop collective, implicit host↔device transfer at dispatch, or
+large array compiled fully replicated when a states-sharded placement was
+requested (wired into the tier-1 repo check next to ``bench_diff``).
 """
 
 from __future__ import annotations

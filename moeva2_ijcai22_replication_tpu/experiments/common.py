@@ -194,11 +194,14 @@ def setup_jax_cache(config: dict | None = None) -> None:
     per program shape; an rq grid revisits the same handful of shapes across
     many processes). ``system.jax_cache_dir: ""`` disables.
 
-    Also applies ``system.cost_ledger`` (default on): this is the one
-    process-level setup hook every runner and bench path already calls."""
+    Also applies ``system.cost_ledger`` and ``system.mesh_telemetry``
+    (both default on): this is the one process-level setup hook every
+    runner and bench path already calls."""
     from ..observability.ledger import configure_ledger
+    from ..observability.mesh import configure_mesh_capture
 
     configure_ledger(config)
+    configure_mesh_capture(config)
     import jax
 
     cache_dir = ".jax_cache"

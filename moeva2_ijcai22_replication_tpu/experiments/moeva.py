@@ -33,6 +33,7 @@ from ..domains import augmentation
 from ..observability import (
     Trace,
     get_ledger,
+    get_mesh_capture,
     quality_block,
     recorder_for,
     telemetry_block,
@@ -128,8 +129,10 @@ def run(config: dict, pipeline=None):
     )
     timer = PhaseTimer(trace=trace)
     # cost-ledger window: the metrics' telemetry.cost reports THIS run's
-    # executables/compiles, not the process lifetime (shared-engine grids)
+    # executables/compiles, not the process lifetime (shared-engine grids);
+    # the mesh-balance mark scopes telemetry.mesh the same way
     ledger_mark = get_ledger().mark()
+    mesh_mark = get_mesh_capture().mark()
 
     # ----- Load and create necessary objects (04_moeva.py:41-60)
     with timer.phase("setup"):
@@ -282,6 +285,10 @@ def run(config: dict, pipeline=None):
                 if moeva.mesh is not None
                 else None,
                 ledger_since=ledger_mark,
+                # multi-device runs carry telemetry.mesh (per-device
+                # roofline + balance + collectives), window-scoped
+                mesh=describe_mesh(moeva.mesh),
+                mesh_since=mesh_mark,
                 quality=quality_block(
                     # drop the mesh-pad duplicate rows (pad_states above)
                     # exactly like x_attacks — padded rates would drift

@@ -32,6 +32,7 @@ from ..domains import augmentation
 from ..observability import (
     Trace,
     get_ledger,
+    get_mesh_capture,
     quality_block,
     recorder_for,
     telemetry_block,
@@ -122,8 +123,10 @@ def run(config: dict, pipeline=None):
     )
     timer = PhaseTimer(trace=trace)
     # cost-ledger window: the metrics' telemetry.cost reports THIS run's
-    # executables/compiles, not the process lifetime (shared-engine grids)
+    # executables/compiles, not the process lifetime (shared-engine grids);
+    # the mesh-balance mark scopes telemetry.mesh the same way
     ledger_mark = get_ledger().mark()
+    mesh_mark = get_mesh_capture().mark()
     apply_sat = "sat" in config["loss_evaluation"]
 
     with timer.phase("setup"):
@@ -283,6 +286,10 @@ def run(config: dict, pipeline=None):
                 if attack.mesh is not None
                 else None,
                 ledger_since=ledger_mark,
+                # multi-device runs carry telemetry.mesh (per-device
+                # roofline + balance + collectives), window-scoped
+                mesh=describe_mesh(attack.mesh),
+                mesh_since=mesh_mark,
                 quality=quality_block(
                     final={
                         "judged": "post_hoc_f64",

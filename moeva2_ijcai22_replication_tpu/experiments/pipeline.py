@@ -41,6 +41,7 @@ import time
 from ..observability import (
     TraceRecorder,
     get_ledger,
+    get_mesh_capture,
     quality_block,
     telemetry_block,
     validate_record,
@@ -79,9 +80,11 @@ class GridPipeline:
         self._artifacts0 = common.ARTIFACTS.stats()
         self._engines0 = common.ENGINES.stats()
         # cost-ledger snapshots: the report scopes the process ledger to
-        # this sweep (executables/compile-seconds added BY the grid)
+        # this sweep (executables/compile-seconds added BY the grid); the
+        # mesh-balance mark scopes telemetry.mesh the same way
         self._ledger0 = get_ledger().summary()
         self._ledger_mark = get_ledger().mark()
+        self._mesh_mark = get_mesh_capture().mark()
 
     # -- background writer ---------------------------------------------------
     def _worker(self):
@@ -186,6 +189,15 @@ class GridPipeline:
             )
 
         launched = [p for p in points if not p["skipped"]]
+        # resolve the grid's mesh identity (config mesh_devices may be -1 =
+        # all visible devices): the execution block records the RESOLVED
+        # count and multi-device grids carry telemetry.mesh
+        try:
+            from ..attacks.sharding import describe_mesh
+
+            mesh_desc = describe_mesh(common.build_mesh(grid_config))
+        except Exception:
+            mesh_desc = None
         report = {
             "grid_config_hash": get_dict_hash(grid_config),
             "grid_wallclock_s": round(time.perf_counter() - self._t0, 3),
@@ -216,13 +228,19 @@ class GridPipeline:
             "execution": {
                 "pipeline": True,
                 "mesh_devices": int(
-                    (grid_config.get("system") or {}).get("mesh_devices", 0)
+                    (mesh_desc or {}).get("devices")
+                    or (grid_config.get("system") or {}).get(
+                        "mesh_devices", 0
+                    )
                     or 0
                 ),
+                "mesh": mesh_desc,
             },
             "telemetry": telemetry_block(
                 recorder=self.recorder,
                 ledger_since=self._ledger_mark,
+                mesh=mesh_desc,
+                mesh_since=self._mesh_mark,
                 # grid-level quality: per-point interior/final summaries
                 # (the curves stay in the metrics JSONs they came from)
                 quality=dict(

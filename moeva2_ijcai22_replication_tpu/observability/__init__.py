@@ -34,6 +34,19 @@ from .ledger import (
     get_ledger,
     ledger_context,
 )
+from .mesh import (
+    HOT_LOOP_PRODUCERS,
+    MESH,
+    MESH_KEYS,
+    MeshCapture,
+    configure_mesh_capture,
+    get_mesh_capture,
+    mesh_block,
+    mesh_snapshot,
+    probe_collectives,
+    probe_shardings,
+    validate_mesh,
+)
 from .quality import (
     DEFAULT_INTERIOR_BUDGETS,
     QUALITY_KEYS,
@@ -64,6 +77,7 @@ from .slo import (
 from .trace import (
     Trace,
     TraceRecorder,
+    all_device_memory_stats,
     current_trace,
     default_recorder,
     device_memory_stats,
@@ -75,7 +89,10 @@ from .trace import (
 __all__ = [
     "DEFAULT_INTERIOR_BUDGETS",
     "DEFAULT_LATENCY_BUCKETS",
+    "HOT_LOOP_PRODUCERS",
     "LEDGER",
+    "MESH",
+    "MESH_KEYS",
     "QUALITY_KEYS",
     "REQUIRED_RECORD_KEYS",
     "SHED_CAUSES",
@@ -86,21 +103,29 @@ __all__ = [
     "Histogram",
     "LedgerEntry",
     "LedgeredJit",
+    "MeshCapture",
     "SloTracker",
     "Trace",
     "TraceRecorder",
+    "all_device_memory_stats",
     "build_identity",
     "configure_ledger",
+    "configure_mesh_capture",
     "current_ledger_context",
     "current_trace",
     "default_recorder",
     "detect_knee",
     "device_memory_stats",
     "get_ledger",
+    "get_mesh_capture",
     "interior_summary",
     "ledger_context",
     "maybe_span",
     "merge_chunk_quality",
+    "mesh_block",
+    "mesh_snapshot",
+    "probe_collectives",
+    "probe_shardings",
     "quality_block",
     "recorder_for",
     "sample_from_per_state",
@@ -108,6 +133,7 @@ __all__ = [
     "telemetry_block",
     "trim_quality",
     "use_trace",
+    "validate_mesh",
     "validate_quality",
     "validate_record",
 ]

@@ -6,8 +6,14 @@ and https://ui.perfetto.dev) wants microsecond ``ts``/``dur`` "X" complete
 events grouped by pid/tid. The mapping here assigns one pid per trace id
 (so every request/run renders as its own process track, with the trace id
 as the track name), "X" events for spans, "i" instants for point events,
-and "C" counter tracks for gauges. ``tools/trace_export.py`` is the CLI
-wrapper.
+and "C" counter tracks for gauges. ``device_run`` spans whose attrs carry
+a ``devices`` count > 1 (the serving dispatch closures attach it for
+mesh-backed engines) fan out onto per-device tracks — tid = device
+ordinal + 1 (tid 0 keeps the trace's other spans), named ``device <n>``
+— so a multi-device trace stops stacking every device's lockstep
+execution on one row; spans without the attr (single-device runs)
+render exactly as before, byte for byte.
+``tools/trace_export.py`` is the CLI wrapper.
 """
 
 from __future__ import annotations
@@ -52,6 +58,7 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
     """Render recorder events to a Chrome/Perfetto trace-event document."""
     trace_events: list[dict] = []
     pids: dict[str, int] = {}
+    named_tids: set = set()
 
     def pid_for(trace_id) -> int:
         tid = str(trace_id)
@@ -69,6 +76,20 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
             )
         return pid
 
+    def name_device_tid(pid: int, tid: int, ordinal: int) -> None:
+        if (pid, tid) in named_tids:
+            return
+        named_tids.add((pid, tid))
+        trace_events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": f"device {ordinal}"},
+            }
+        )
+
     t0_wall = None
     for ev in events:
         kind = ev.get("kind")
@@ -80,6 +101,41 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
             args["span"] = ev.get("span")
             if ev.get("parent") is not None:
                 args["parent"] = ev["parent"]
+            devices = args.get("devices")
+            if (
+                ev.get("name") == "device_run"
+                and isinstance(devices, int)
+                and devices > 1
+            ):
+                # multi-device dispatch: one lockstep slice per device
+                # ordinal (tid = ordinal) instead of stacking the whole
+                # mesh on row 0; per-device HBM rides each slice's args
+                pid = pid_for(ev.get("trace", "?"))
+                hbm_devices = args.pop("hbm_devices", None)
+                for d in range(devices):
+                    # tid = ordinal + 1: tid 0 carries the trace's OTHER
+                    # spans/instants, so device 0 must not land on it
+                    tid = d + 1
+                    name_device_tid(pid, tid, d)
+                    dev_args = dict(args, device=d)
+                    if isinstance(hbm_devices, list) and d < len(
+                        hbm_devices
+                    ):
+                        dev_args["hbm"] = hbm_devices[d]
+                    trace_events.append(
+                        {
+                            "name": ev.get("name", "?"),
+                            "ph": "X",
+                            "pid": pid,
+                            "tid": tid,
+                            "ts": ts_us,
+                            "dur": round(
+                                float(ev.get("dur", 0.0)) * 1e6, 1
+                            ),
+                            "args": dev_args,
+                        }
+                    )
+                continue
             trace_events.append(
                 {
                     "name": ev.get("name", "?"),

@@ -46,6 +46,22 @@ _context: contextvars.ContextVar = contextvars.ContextVar(
     "moeva2_ledger_context", default=None
 )
 
+#: transfer-guard mode applied around every AOT executable dispatch
+#: (``None`` = off, the production default). ``tools/shard_lint.py`` sets
+#: "disallow" so an argument that is not already resident on its devices
+#: — an implicit host→device transfer at dispatch — raises instead of
+#: silently serialising the hot path through the host.
+_dispatch_transfer_guard: str | None = None
+
+
+def set_dispatch_transfer_guard(mode: str | None) -> str | None:
+    """Set the dispatch transfer-guard mode ("disallow"/"log"/None);
+    returns the previous mode so lint callers can restore it."""
+    global _dispatch_transfer_guard
+    prev = _dispatch_transfer_guard
+    _dispatch_transfer_guard = mode
+    return prev
+
 
 @contextlib.contextmanager
 def ledger_context(**attrs):
@@ -167,6 +183,24 @@ class LedgerEntry:
     dispatches: int = 0
     run_s: float = 0.0  #: attributed device+fetch seconds (engines' sync points)
     created_wall: float = field(default_factory=time.time)
+    #: mesh-scale identity (observability.mesh.probe_compiled): device /
+    #: states-partition counts, input/output sharding summary, collective
+    #: census — all None/1 for single-device programs or with the mesh
+    #: capture off, so single-device records stay byte-stable.
+    devices: int = 1
+    partitions: int = 1
+    sharding: dict | None = None
+    collectives: dict | None = None
+
+    def per_device(self) -> dict:
+        """Whole-program cost split across devices (states-partitioned
+        programs split, unpartitioned ones replicate — see
+        ``observability.mesh.per_device_cost``)."""
+        from .mesh import per_device_cost
+
+        return per_device_cost(
+            self.flops, self.bytes_accessed, self.partitions, self.devices
+        )
 
     def roofline(self, dispatches: int | None = None, run_s: float | None = None) -> dict:
         """Achieved rates from the cost model joined with attributed run
@@ -203,7 +237,7 @@ class LedgerEntry:
         dispatches: int | None = None,
         run_s: float | None = None,
     ) -> dict:
-        return {
+        out = {
             "key": self.key,
             "producer": self.producer,
             "identity": self.identity,
@@ -219,6 +253,19 @@ class LedgerEntry:
             "memory": self.memory,
             **self.roofline(dispatches, run_s),
         }
+        if self.devices > 1:
+            # mesh sub-block only on multi-device executables: per-device
+            # cost split, sharding summary, collective census — keeping
+            # single-device entry JSON byte-identical to the pre-mesh
+            # ledger (the committed BENCH series compares against it)
+            out["mesh"] = {
+                "per_device": self.per_device(),
+                "partitions": self.partitions,
+                "devices": self.devices,
+                "sharding": self.sharding,
+                "collectives": self.collectives,
+            }
+        return out
 
 
 class CostLedger:
@@ -248,10 +295,13 @@ class CostLedger:
         cost: dict | None,
         memory: dict | None,
         aot: bool = True,
+        mesh_probe: dict | None = None,
     ) -> LedgerEntry | None:
         """Register a freshly compiled executable; returns its entry (None
         when the ledger is disabled — the compile itself already happened
-        identically either way)."""
+        identically either way). ``mesh_probe`` is an
+        ``observability.mesh.probe_compiled`` result (sharding summary +
+        collective census) for multi-device programs."""
         with self._lock:
             self.misses += 1
             if not self.enabled:
@@ -271,6 +321,10 @@ class CostLedger:
                 transcendentals=(cost or {}).get("transcendentals"),
                 memory=memory,
                 aot=aot,
+                devices=int((mesh_probe or {}).get("devices") or 1),
+                partitions=int((mesh_probe or {}).get("partitions") or 1),
+                sharding=(mesh_probe or {}).get("sharding"),
+                collectives=(mesh_probe or {}).get("collectives"),
             )
             self._entries[key] = entry
             if cause is not None:
@@ -611,12 +665,32 @@ class LedgeredJit:
         )
 
     # -- compile -------------------------------------------------------------
+    @staticmethod
+    def _mesh_probe(compiled, lowered) -> dict | None:
+        """Best-effort mesh probe of a fresh executable (sharding specs +
+        collective census) — compile-time only, skipped entirely when the
+        mesh capture is off, and never allowed to fail the compile."""
+        try:
+            from .mesh import get_mesh_capture, probe_compiled
+
+            if not get_mesh_capture().enabled:
+                return None
+            probe = probe_compiled(
+                compiled, out_info=getattr(lowered, "out_info", None)
+            )
+            # single-device programs carry no mesh payload (keeps their
+            # ledger entries byte-identical to the pre-mesh schema)
+            return probe if probe.get("devices", 1) > 1 else None
+        except Exception:
+            return None
+
     def _compile(self, args, kwargs):
         import jax
 
         t0 = time.perf_counter()
         try:
-            compiled = self._jitted.lower(*args, **kwargs).compile()
+            lowered = self._jitted.lower(*args, **kwargs)
+            compiled = lowered.compile()
         except Exception:
             # AOT unavailable for this signature: plain jit dispatch —
             # behavior is preserved, the ledger records the degradation
@@ -639,6 +713,7 @@ class LedgeredJit:
             compile_s=compile_s,
             cost=probe_cost_analysis(compiled),
             memory=probe_memory_analysis(compiled),
+            mesh_probe=self._mesh_probe(compiled, lowered),
         )
         return (compiled, entry, compile_s)
 
@@ -697,7 +772,17 @@ class LedgeredJit:
                 out = self._jitted(*args, **kwargs)
         else:
             dyn, _ = self._split(args)
-            out = compiled(*dyn)
+            if _dispatch_transfer_guard is not None:
+                import jax
+
+                # the lint seam: with the guard armed, any argument not
+                # already resident on its devices trips here — the
+                # "implicit host↔device transfer in the dispatch path"
+                # rule of tools/shard_lint.py
+                with jax.transfer_guard(_dispatch_transfer_guard):
+                    out = compiled(*dyn)
+            else:
+                out = compiled(*dyn)
         if entry is not None:
             self._ledger.record_dispatch(entry.key)
         if self._on_dispatch is not None:

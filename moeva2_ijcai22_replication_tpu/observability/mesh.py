@@ -1,0 +1,706 @@
+"""Mesh-scale observability: per-device cost, collectives, and balance.
+
+Everything the observability stack built through PR 7 reads ONE device:
+the HBM watermark probes device 0, the roofline is whole-program, and the
+states-sharding contract ("zero collectives in the hot loop",
+``attacks/sharding.py``) is asserted in prose only. This module is the
+mesh-shaped half:
+
+- **Compile-time probes** — :func:`probe_compiled` inspects a freshly
+  compiled executable (the :class:`~.ledger.LedgeredJit` capture point)
+  for its input/output sharding specs and, via the partitioned HLO text
+  (``compiled.as_text()``), its collective-communication ops
+  (all-reduce / all-gather / reduce-scatter / collective-permute /
+  all-to-all) with estimated bytes moved and replica-group sizes. Both
+  probes follow the cost-model discipline: best-effort, never raising,
+  degrading to ``None`` when a backend does not expose them.
+
+- **Per-device cost split** — :func:`per_device_cost` divides a
+  whole-program XLA cost model by the states-axis partition count
+  (falling back to replicated cost — every device pays the full program
+  — when nothing was partitioned), which joined with the balance
+  tracker's per-device run seconds yields a per-device roofline.
+
+- **Balance telemetry** — :class:`MeshCapture`, a process-wide
+  accumulator the engines feed at their *existing* sync points (never by
+  adding one): each recorded window attributes run seconds to devices in
+  proportion to their live-row share (SPMD devices run in lockstep, so a
+  device whose rows all parked is paying wall-clock for no useful work).
+  The balance ratio (mean/max useful seconds, 1.0 = perfectly balanced)
+  is gated across the committed bench series by
+  ``tools/bench_diff.py --mesh``.
+
+- **Record schema** — :func:`mesh_block` assembles the ``telemetry.mesh``
+  sub-block (per-device roofline + HBM, balance, collective
+  classification) that :func:`~.records.validate_record` requires on any
+  record whose execution mode says it ran on more than one device;
+  :func:`mesh_snapshot` is the process-cumulative /healthz · /metrics
+  view of the same numbers.
+
+Capture on/off (config ``system.mesh_telemetry``) changes which host-side
+bookkeeping runs, never the compiled programs or the dispatch schedule —
+the tier-1 smoke in ``tests/test_mesh_observability.py`` pins zero extra
+compiles/dispatches and bit-identical results either way.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from .trace import all_device_memory_stats
+
+#: HLO collective op mnemonics counted by the communication ledger.
+#: Order matters: longest-prefix first so "all-reduce-scatter" style
+#: compounds cannot be claimed by a shorter name.
+COLLECTIVE_OPS = (
+    "reduce-scatter",
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+)
+
+#: producers whose executables ARE the hot loop: a collective here breaks
+#: the zero-collective states-sharding contract (init/gate programs run
+#: once per segment boundary, not per generation/iteration).
+HOT_LOOP_PRODUCERS = ("pgd_attack", "moeva_segment")
+
+#: HLO primitive-type byte widths (tuple/token types carry no payload we
+#: can attribute; unknown types fall back to 4 bytes).
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+#: one HLO instruction's result-shape tokens: ``f32[16,4]``; dims may be
+#: empty (scalar).
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+#: iota-form replica groups: ``replica_groups=[<n_groups>,<group_size>]<=``
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+#: list-form replica groups: ``replica_groups={{0,1},{2,3}}``
+_LIST_GROUPS_RE = re.compile(r"replica_groups=\{\{([0-9, ]*)\}")
+
+
+def _shape_bytes(type_text: str) -> tuple[float, float]:
+    """``(total_bytes, float_bytes)`` of every ``dtype[dims]`` token in a
+    result-type string (handles tuple-shaped async collective results).
+    Float bytes are tracked separately: they are candidate/objective DATA
+    crossing devices, as opposed to the u32 RNG-key material, pred
+    loop-consensus scalars, and s32 index exchanges the SPMD partitioner
+    inserts on its own (the lint's hot-loop rule keys off this split)."""
+    total = 0.0
+    float_total = 0.0
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        if dtype == "token":
+            continue
+        n = 1.0
+        if dims:
+            for d in dims.split(","):
+                n *= float(d)
+        b = n * _DTYPE_BYTES.get(dtype, 4)
+        total += b
+        if dtype.startswith(("f", "bf", "c")):
+            float_total += b
+    return total, float_total
+
+
+def parse_collectives(hlo_text: str) -> dict:
+    """Count collective ops (and estimate bytes moved) in partitioned HLO.
+
+    One entry per *logical* collective: the async ``-start``/``-done``
+    pairs XLA emits count once (at ``-start``). Bytes are the result-shape
+    payload — a deliberate, documented lower-bound estimate (a ring
+    all-reduce moves ~2(n-1)/n of it per device; what the lint and the
+    classification need is "zero vs not zero" and relative magnitude, not
+    a NIC-accurate byte count). ``float_count``/``float_bytes`` split out
+    collectives moving floating-point payloads: actual candidate /
+    objective data, as opposed to the u32 RNG-key derivation, pred
+    loop-consensus, and s32 index traffic XLA's SPMD partitioner inserts
+    even into embarrassingly parallel programs — the lint tolerates the
+    latter (bounded) and fails the former. ``group_sizes`` histograms the
+    replica group sizes seen, which :func:`collective_axes` maps back to
+    mesh axes."""
+    ops: dict[str, dict] = {}
+    group_sizes: dict[str, int] = {}
+    count = 0
+    bytes_total = 0.0
+    float_count = 0
+    float_bytes = 0.0
+    for line in hlo_text.splitlines():
+        # find the collective this line dispatches: the call token is
+        # " <op>(" or " <op>-start(". Matching the TOKEN (not a prefix of
+        # the text before the first "(") is load-bearing twice over: async
+        # starts returning TUPLES — "(f32[..], f32[..]) all-gather-start("
+        # — put a "(" before the op name, and "-done" completions (already
+        # counted at -start) never match because no bare/-start token does.
+        op, idx = None, -1
+        for cand in COLLECTIVE_OPS:
+            for suffix in ("(", "-start("):
+                i = line.find(f" {cand}{suffix}")
+                if i >= 0:
+                    op, idx = cand, i
+                    break
+            if op is not None:
+                break
+        if op is None:
+            continue
+        # result type(s) live between '=' and the op call; a tuple-shaped
+        # async result counts every member (operand alias included — the
+        # estimate stays order-of-magnitude, which is all the lint needs)
+        _, _, result = line[:idx].rpartition("=")
+        b, fb = _shape_bytes(result)
+        slot = ops.setdefault(
+            op, {"count": 0, "bytes": 0.0, "float_count": 0, "float_bytes": 0.0}
+        )
+        slot["count"] += 1
+        slot["bytes"] += b
+        count += 1
+        bytes_total += b
+        if fb > 0:
+            slot["float_count"] += 1
+            slot["float_bytes"] += fb
+            float_count += 1
+            float_bytes += fb
+        m = _IOTA_GROUPS_RE.search(line)
+        if m:
+            gs = m.group(2)
+        else:
+            m = _LIST_GROUPS_RE.search(line)
+            gs = str(m.group(1).count(",") + 1) if m and m.group(1).strip() else None
+        if gs is not None:
+            group_sizes[gs] = group_sizes.get(gs, 0) + 1
+    for slot in ops.values():
+        slot["bytes"] = float(slot["bytes"])
+        slot["float_bytes"] = float(slot["float_bytes"])
+    return {
+        "count": count,
+        "bytes": float(bytes_total),
+        "float_count": float_count,
+        "float_bytes": float(float_bytes),
+        "ops": ops,
+        "group_sizes": group_sizes,
+    }
+
+
+def probe_collectives(compiled) -> dict | None:
+    """Best-effort collective census of a compiled executable via its
+    partitioned HLO text; ``None`` when the backend/runtime exposes no
+    ``as_text()`` (same degrade-to-unavailable discipline as the cost
+    probes — observability must never take an attack down)."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not isinstance(text, str) or not text:
+        return None
+    try:
+        return parse_collectives(text)
+    except Exception:
+        return None
+
+
+def _sharding_partitions(sharding) -> tuple[int, int]:
+    """(devices, partitions) of one sharding: how many devices hold the
+    array, and into how many distinct shards its data splits (1 = fully
+    replicated). Works for NamedSharding (mesh axes named in the spec)
+    and degrades to device-set arithmetic otherwise."""
+    devices = len(getattr(sharding, "device_set", ()) or ()) or 1
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return devices, 1
+    try:
+        shape = dict(mesh.shape)
+        parts = 1
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is not None:
+                    parts *= int(shape.get(ax, 1))
+        return devices, parts
+    except Exception:
+        return devices, 1
+
+
+def _aval_bytes(aval) -> int:
+    import numpy as np
+
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = getattr(aval, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return int(n * itemsize)
+
+
+def _sharding_rows(shardings, avals) -> list[dict]:
+    import jax
+
+    sh_leaves = jax.tree_util.tree_leaves(shardings)
+    av_leaves = jax.tree_util.tree_leaves(avals)
+    rows = []
+    for sh, av in zip(sh_leaves, av_leaves):
+        devices, parts = _sharding_partitions(sh)
+        spec = getattr(sh, "spec", None)
+        rows.append(
+            {
+                "spec": str(spec) if spec is not None else None,
+                "devices": devices,
+                "partitions": parts,
+                "sharded": parts > 1,
+                "bytes": _aval_bytes(av),
+            }
+        )
+    return rows
+
+
+def _summarize(rows: list[dict]) -> dict:
+    largest = max(rows, key=lambda r: r["bytes"], default=None)
+    return {
+        "arrays": len(rows),
+        "sharded": sum(1 for r in rows if r["sharded"]),
+        "sharded_bytes": int(sum(r["bytes"] for r in rows if r["sharded"])),
+        "replicated_bytes": int(
+            sum(r["bytes"] for r in rows if not r["sharded"])
+        ),
+        "max_replicated_bytes": int(
+            max((r["bytes"] for r in rows if not r["sharded"]), default=0)
+        ),
+        "largest": dict(largest) if largest else None,
+    }
+
+
+def probe_shardings(compiled, out_info=None) -> dict | None:
+    """Best-effort input/output sharding summary of a compiled executable:
+    per-direction array counts, sharded vs replicated byte totals, and the
+    largest array's spec — what the ledger entry records and
+    ``tools/shard_lint.py`` lints. ``out_info`` is the lowered stage's
+    ``out_info`` (shape/dtype leaves for the outputs, which the Compiled
+    object itself does not expose on jax 0.4.x)."""
+    try:
+        in_sh = compiled.input_shardings
+        in_avals = compiled.in_avals
+        if isinstance(in_sh, tuple) and len(in_sh) == 2:
+            in_sh = in_sh[0]  # (args, kwargs) pair on jax 0.4.x
+        if isinstance(in_avals, tuple) and len(in_avals) == 2:
+            in_avals = in_avals[0]
+        in_rows = _sharding_rows(in_sh, in_avals)
+        out_rows = (
+            _sharding_rows(compiled.output_shardings, out_info)
+            if out_info is not None
+            else []
+        )
+    except Exception:
+        return None
+    if not in_rows and not out_rows:
+        return None
+    all_rows = in_rows + out_rows
+    return {
+        "devices": max((r["devices"] for r in all_rows), default=1),
+        "partitions": max((r["partitions"] for r in all_rows), default=1),
+        "in": _summarize(in_rows),
+        "out": _summarize(out_rows) if out_rows else None,
+    }
+
+
+def probe_compiled(compiled, out_info=None) -> dict:
+    """The one mesh probe :class:`~.ledger.LedgeredJit` runs per compile:
+    sharding summary + collective census + derived device/partition
+    counts. Pure compile-time introspection — dispatch is untouched."""
+    sharding = probe_shardings(compiled, out_info=out_info)
+    collectives = probe_collectives(compiled)
+    return {
+        "devices": (sharding or {}).get("devices", 1),
+        "partitions": (sharding or {}).get("partitions", 1),
+        "sharding": sharding,
+        "collectives": collectives,
+    }
+
+
+def per_device_cost(
+    flops, bytes_accessed, partitions: int, devices: int
+) -> dict:
+    """Split a whole-program cost model across devices: a states-partitioned
+    program does ``1/partitions`` of the work per device; an unpartitioned
+    one is replicated — every device pays the full program (the honest
+    fallback the tentpole requires, not a silent ``/devices``)."""
+    replicated = partitions <= 1
+    div = 1 if replicated else partitions
+    return {
+        "devices": int(devices),
+        "partitions": int(partitions),
+        "replicated": replicated,
+        "flops": None if flops is None else float(flops) / div,
+        "bytes_accessed": (
+            None if bytes_accessed is None else float(bytes_accessed) / div
+        ),
+    }
+
+
+def collective_axes(group_sizes: dict, mesh_desc: dict | None) -> dict:
+    """Map a replica-group-size histogram back onto mesh axes: a group
+    size equal to exactly one axis extent attributes to that axis; the
+    whole-mesh size attributes to ``"all"``; anything else stays
+    ``"group<size>"`` (honest about ambiguity — a 2x4 mesh cannot tell a
+    size-8 'all' group from a flattened two-axis group)."""
+    out: dict[str, int] = {}
+    axes = []
+    if mesh_desc:
+        axes = list(zip(mesh_desc.get("axes") or [], mesh_desc.get("shape") or []))
+    total = (mesh_desc or {}).get("devices")
+    for gs_str, n in (group_sizes or {}).items():
+        try:
+            gs = int(gs_str)
+        except (TypeError, ValueError):
+            continue
+        matches = [name for name, size in axes if int(size) == gs]
+        if total is not None and gs == int(total):
+            key = "all" if len(axes) != 1 else axes[0][0]
+        elif len(matches) == 1:
+            key = matches[0]
+        else:
+            key = f"group{gs}"
+        out[key] = out.get(key, 0) + int(n)
+    return out
+
+
+# -- balance telemetry --------------------------------------------------------
+class MeshCapture:
+    """Process-wide per-device balance accumulator.
+
+    Engines call :meth:`record_balance` at sync points they already have
+    (MoEvA's run attribution after the final fetch, PGD's post-fetch run
+    attribution) with the live-row count per device for the window and the
+    window's attributed run seconds. Useful seconds per device scale with
+    its live-row share of the busiest device: SPMD lockstep means every
+    device pays the same wall-clock, so a device carrying only parked or
+    pad rows accrues wall-clock but no useful seconds — exactly the skew
+    the balance ratio surfaces."""
+
+    def __init__(self, enabled: bool = True):
+        self._lock = threading.Lock()
+        self.enabled = enabled
+        self._useful_s: dict[int, float] = {}
+        self._sync_points = 0
+        self._attributed_s = 0.0
+        self._devices = 0
+
+    def record_balance(self, per_device_rows, seconds: float) -> None:
+        """Attribute ``seconds`` of run time across devices by live-row
+        share. No-op when capture is off, the window is empty, or the
+        duration is non-positive — and never raises."""
+        if not self.enabled or seconds is None or seconds <= 0:
+            return
+        try:
+            rows = [max(float(r), 0.0) for r in per_device_rows]
+        except (TypeError, ValueError):
+            return
+        if not rows:
+            return
+        top = max(rows)
+        if top <= 0:
+            return
+        with self._lock:
+            self._devices = max(self._devices, len(rows))
+            self._sync_points += 1
+            self._attributed_s += float(seconds)
+            for d, r in enumerate(rows):
+                self._useful_s[d] = (
+                    self._useful_s.get(d, 0.0) + float(seconds) * r / top
+                )
+
+    def mark(self) -> dict:
+        """Opaque snapshot for window-scoped balance blocks (the
+        ``telemetry.mesh`` discipline mirrors ``CostLedger.mark``)."""
+        with self._lock:
+            return {
+                "useful": dict(self._useful_s),
+                "sync_points": self._sync_points,
+                "attributed_s": self._attributed_s,
+            }
+
+    def balance_block(self, since: dict | None = None) -> dict:
+        """JSON-ready balance view, optionally scoped to a window since a
+        :meth:`mark`. ``ratio`` = mean/max useful seconds over the window
+        (1.0 = perfectly balanced, lower = skewed); ``None`` with no
+        attributed windows."""
+        with self._lock:
+            useful = dict(self._useful_s)
+            sync_points = self._sync_points
+            attributed = self._attributed_s
+            devices = self._devices
+        prev = (since or {}).get("useful", {})
+        if since is not None:
+            useful = {
+                d: v - prev.get(d, 0.0)
+                for d, v in useful.items()
+                if v - prev.get(d, 0.0) > 0 or d in prev
+            }
+            sync_points -= since.get("sync_points", 0)
+            attributed -= since.get("attributed_s", 0.0)
+        per_device = [
+            round(useful.get(d, 0.0), 6) for d in range(devices)
+        ]
+        top = max(per_device, default=0.0)
+        ratio = (
+            round(sum(per_device) / (len(per_device) * top), 4)
+            if per_device and top > 0
+            else None
+        )
+        return {
+            "devices": devices,
+            "per_device_s": per_device,
+            "ratio": ratio,
+            "sync_points": sync_points,
+            "attributed_s": round(max(attributed, 0.0), 6),
+        }
+
+    def reset(self) -> None:
+        """Drop all state (tests only)."""
+        with self._lock:
+            self._useful_s.clear()
+            self._sync_points = 0
+            self._attributed_s = 0.0
+            self._devices = 0
+
+
+#: THE process capture — engines and record producers share it the same
+#: way they share ``ledger.LEDGER``.
+MESH = MeshCapture()
+
+
+def get_mesh_capture() -> MeshCapture:
+    return MESH
+
+
+def configure_mesh_capture(config: dict | None) -> MeshCapture:
+    """Apply config ``system.mesh_telemetry`` (default on; the capture is
+    a compile-time probe plus a few dict writes per engine sync point)."""
+    enabled = (config or {}).get("system", {}).get("mesh_telemetry", True)
+    MESH.enabled = bool(enabled)
+    return MESH
+
+
+# -- record / endpoint assembly ----------------------------------------------
+def _entry_mesh(entry_dict: dict) -> dict | None:
+    m = entry_dict.get("mesh")
+    return m if isinstance(m, dict) else None
+
+
+def _aggregate_collectives(entries: list[dict], mesh_desc: dict | None) -> dict:
+    """Fold the per-executable collective censuses (scaled by window
+    dispatch counts) into one record-level view, split hot-loop vs other
+    producers — the compute-vs-comm classification input."""
+    total = {"count": 0, "bytes": 0.0, "float_count": 0, "float_bytes": 0.0}
+    hot = {"count": 0, "bytes": 0.0, "float_count": 0, "float_bytes": 0.0}
+    by_op: dict[str, dict] = {}
+    group_sizes: dict[str, int] = {}
+    available = False
+    for e in entries:
+        mesh = _entry_mesh(e)
+        col = (mesh or {}).get("collectives")
+        if not isinstance(col, dict):
+            continue
+        available = True
+        d = max(int(e.get("dispatches") or 0), 1)
+        for agg in (total, hot) if e.get("producer") in HOT_LOOP_PRODUCERS else (total,):
+            agg["count"] += col.get("count", 0) * d
+            agg["bytes"] += col.get("bytes", 0.0) * d
+            agg["float_count"] += col.get("float_count", 0) * d
+            agg["float_bytes"] += col.get("float_bytes", 0.0) * d
+        for op, slot in (col.get("ops") or {}).items():
+            agg = by_op.setdefault(op, {"count": 0, "bytes": 0.0})
+            agg["count"] += slot.get("count", 0) * d
+            agg["bytes"] += slot.get("bytes", 0.0) * d
+        for gs, n in (col.get("group_sizes") or {}).items():
+            group_sizes[gs] = group_sizes.get(gs, 0) + int(n) * d
+    return {
+        "available": available,
+        "count": total["count"],
+        "bytes": float(total["bytes"]),
+        "float_count": total["float_count"],
+        "float_bytes": float(total["float_bytes"]),
+        "hot_loop": {
+            "count": hot["count"],
+            "bytes": float(hot["bytes"]),
+            "float_count": hot["float_count"],
+            "float_bytes": float(hot["float_bytes"]),
+        },
+        "by_op": by_op,
+        "by_axis": collective_axes(group_sizes, mesh_desc),
+    }
+
+
+def mesh_block(
+    mesh_desc: dict,
+    *,
+    ledger=None,
+    ledger_since: dict | None = None,
+    capture: MeshCapture | None = None,
+    capture_since: dict | None = None,
+) -> dict:
+    """Assemble the ``telemetry.mesh`` sub-block for a record that ran on
+    the mesh described by ``mesh_desc`` (an ``attacks.sharding.
+    describe_mesh`` dict). Window discipline mirrors ``telemetry.cost``:
+    ``ledger_since``/``capture_since`` scope the per-device numbers to
+    this run. With capture off the block degrades to
+    ``{"enabled": False, ...identity...}`` — still schema-valid, so a
+    capture-off multi-device record does not fail validation."""
+    capture = capture if capture is not None else MESH
+    devices = int(mesh_desc.get("devices") or 1)
+    if not capture.enabled:
+        return {
+            "enabled": False,
+            "devices": devices,
+            "shape": mesh_desc.get("shape"),
+            "axes": mesh_desc.get("axes"),
+        }
+    from .ledger import get_ledger
+
+    led = ledger if ledger is not None else get_ledger()
+    cost = led.cost_block(since=ledger_since)
+    entries = cost.get("entries") or []
+    balance = capture.balance_block(since=capture_since)
+    # per-device model FLOPs over the window, dispatch-weighted from each
+    # entry's own mesh.per_device block (the ONE place the split rule
+    # lives — per_device_cost: partitioned divides, replicated charges
+    # every device the full program). Entries WITHOUT a mesh payload ran
+    # on a single device: their cost belongs to that device alone, never
+    # to the whole mesh, so they stay out of the per-device numbers.
+    flops_per_device = 0.0
+    bytes_per_device = 0.0
+    cost_available = False
+    for e in entries:
+        d = int(e.get("dispatches") or 0)
+        if not d:
+            continue
+        pd = (_entry_mesh(e) or {}).get("per_device")
+        if not isinstance(pd, dict):
+            continue
+        if isinstance(pd.get("flops"), (int, float)):
+            flops_per_device += pd["flops"] * d
+            cost_available = True
+        if isinstance(pd.get("bytes_accessed"), (int, float)):
+            bytes_per_device += pd["bytes_accessed"] * d
+    hbm = all_device_memory_stats()
+    # SPMD lockstep: every device pays the same wall-clock (the window's
+    # attributed seconds) and executes the same per-shard program, so the
+    # per-device achieved rate is uniform; the *useful* seconds from the
+    # balance tracker expose the skew as a utilization fraction instead
+    # of (misleadingly) inflating an underloaded device's FLOP/s.
+    wall_s = balance["attributed_s"]
+    per_device = []
+    for d in range(devices):
+        useful_s = (
+            balance["per_device_s"][d]
+            if d < len(balance["per_device_s"])
+            else 0.0
+        )
+        per_device.append(
+            {
+                "device": d,
+                "run_s": useful_s,
+                "useful_fraction": (
+                    round(useful_s / wall_s, 4) if wall_s > 0 else None
+                ),
+                "flops": flops_per_device if cost_available else None,
+                "bytes_accessed": (
+                    bytes_per_device if cost_available else None
+                ),
+                "achieved_flops_s": (
+                    round(flops_per_device / wall_s, 1)
+                    if cost_available and wall_s > 0
+                    else None
+                ),
+                "hbm": (
+                    (hbm or {}).get("per_device", [None] * devices)[d]
+                    if hbm and d < len((hbm or {}).get("per_device") or [])
+                    else None
+                ),
+            }
+        )
+    collectives = _aggregate_collectives(entries, mesh_desc)
+    comm_bytes = collectives["bytes"]
+    compute_bytes = bytes_per_device * (1 if devices else 0)
+    return {
+        "enabled": True,
+        "devices": devices,
+        "shape": mesh_desc.get("shape"),
+        "axes": mesh_desc.get("axes"),
+        "per_device": per_device,
+        "balance": {
+            "ratio": balance["ratio"],
+            "sync_points": balance["sync_points"],
+            "attributed_s": balance["attributed_s"],
+        },
+        "collectives": collectives,
+        # compute-vs-comm classification of the window: collective bytes
+        # against per-device HBM traffic — on the contract-clean attack
+        # programs comm_fraction must be 0 in the hot loop
+        "classification": {
+            "comm_bytes": comm_bytes,
+            "compute_bytes_per_device": (
+                compute_bytes if cost_available else None
+            ),
+            "comm_fraction": (
+                round(comm_bytes / (comm_bytes + compute_bytes), 6)
+                if cost_available and (comm_bytes + compute_bytes) > 0
+                else None
+            ),
+        },
+    }
+
+
+#: keys a capture-on ``telemetry.mesh`` block must carry.
+MESH_KEYS = ("devices", "per_device", "balance", "collectives")
+
+
+def validate_mesh(block, kind: str = "record") -> dict:
+    """Assert a ``telemetry.mesh`` block is well-formed; returns it.
+    A capture-off block (``enabled: False``) passes — the knob is allowed
+    to be off, dropping the block entirely is not."""
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} record's telemetry.mesh block must be a dict, got "
+            f"{type(block).__name__}"
+        )
+    if block.get("enabled") is False:
+        return block
+    missing = [k for k in MESH_KEYS if k not in block]
+    if missing:
+        raise ValueError(
+            f"{kind} record's telemetry.mesh block is missing {missing}: "
+            "assemble it with observability.mesh.mesh_block so per-device "
+            "roofline, balance, and collective attribution travel with "
+            "every multi-device record"
+        )
+    return block
+
+
+def mesh_snapshot(ledger=None, capture: MeshCapture | None = None) -> dict:
+    """Process-cumulative mesh view for /healthz and /metrics: local
+    device count, per-device HBM watermarks, balance, and the collective
+    census aggregated over every ledgered executable. Device count is
+    best-effort (None before JAX initialises)."""
+    capture = capture if capture is not None else MESH
+    try:
+        import jax
+
+        device_count = len(jax.devices())
+    except Exception:
+        device_count = None
+    from .ledger import get_ledger
+
+    led = ledger if ledger is not None else get_ledger()
+    entries = [e.as_dict() for e in led.entries()]
+    return {
+        "enabled": capture.enabled,
+        "device_count": device_count,
+        "hbm": all_device_memory_stats(),
+        "balance": capture.balance_block(),
+        "collectives": _aggregate_collectives(entries, None),
+    }

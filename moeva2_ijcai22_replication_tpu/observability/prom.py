@@ -72,6 +72,19 @@ _HELP = {
     "capacity_headroom": "1 - utilization: fraction of the replica's device time still available, per domain",
     "capacity_calibration_error": "Mean |predicted - actual| / actual run seconds per batch: how faithfully FLOPs predict device time",
     "capacity_window_batches": "Batch dispatches currently in the capacity window, per domain",
+    "mesh_devices": "Local devices visible to this replica",
+    "mesh_balance_ratio": "Per-device balance ratio (mean/max useful run seconds; 1.0 = perfectly balanced)",
+    "mesh_balance_sync_points": "Engine sync points that contributed per-device balance windows",
+    "mesh_attributed_s": "Run seconds attributed to per-device balance windows",
+    "device_run_s": "Useful run seconds attributed per device ordinal (live-row share of SPMD wall-clock)",
+    "device_hbm_bytes_in_use": "HBM bytes in use per device ordinal",
+    "device_hbm_peak_bytes_in_use": "Peak HBM bytes in use per device ordinal",
+    "collective_ops": "Collective-communication ops dispatched, by HLO op (dispatch-weighted census of ledgered executables)",
+    "collective_bytes": "Estimated bytes moved by collectives, by HLO op (result-shape lower bound)",
+    "collective_hot_loop_ops": "Collective ops inside hot-loop attack executables, incl. tolerated control-plane (u32 RNG/pred consensus) traffic",
+    "collective_hot_loop_float_ops": "Collectives moving FLOAT payload inside hot-loop attack executables (states-sharding contract: must be 0)",
+    "executable_per_device_flops": "Per-device model FLOPs per dispatch (whole-program cost split by states partitioning; replicated cost when unsharded)",
+    "executable_per_device_bytes_accessed": "Per-device bytes accessed per dispatch (whole-program cost split by states partitioning)",
 }
 
 
@@ -127,6 +140,31 @@ def _ledger_lines(prefix: str, block: dict, lines: list[str]) -> None:
             continue
         n = _name(prefix, f"executable_{field}")
         _family(lines, n, "gauge", f"executable_{field}")
+        for e, v in rows:
+            labels = (
+                f'executable="{_escape_label(e.get("key"))}",'
+                f'producer="{_escape_label(e.get("producer"))}"'
+            )
+            lines.append(f"{n}{{{labels}}} {_fmt(v)}")
+    # per-device cost split of multi-device executables: the whole-program
+    # cost model divided by the states partition count (replicated cost
+    # when unsharded) — the per-device roofline's numerator
+    for src, key in (
+        ("flops", "per_device_flops"),
+        ("bytes_accessed", "per_device_bytes_accessed"),
+    ):
+        rows = [
+            (e, ((e.get("mesh") or {}).get("per_device") or {}).get(src))
+            for e in entries
+            if isinstance(
+                ((e.get("mesh") or {}).get("per_device") or {}).get(src),
+                (int, float),
+            )
+        ]
+        if not rows:
+            continue
+        n = _name(prefix, f"executable_{key}")
+        _family(lines, n, "gauge", f"executable_{key}")
         for e, v in rows:
             labels = (
                 f'executable="{_escape_label(e.get("key"))}",'
@@ -268,6 +306,77 @@ def _capacity_lines(prefix: str, block: dict, lines: list[str]) -> None:
             lines.append(f'{n}{{domain="{_escape_label(domain)}"}} {_fmt(v)}')
 
 
+def _mesh_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Mesh exposition (``observability.mesh.mesh_snapshot``): scalar
+    balance gauges, one ``{device}``-labeled gauge family per per-device
+    measure (label cardinality bounded by the local device count — device
+    ordinals, never ids), and a ``{op}``-labeled collective census from
+    the fixed HLO op taxonomy."""
+    dc = block.get("device_count")
+    if isinstance(dc, int):
+        n = _name(prefix, "mesh_devices")
+        _family(lines, n, "gauge", "mesh_devices")
+        lines.append(f"{n} {_fmt(dc)}")
+    balance = block.get("balance") or {}
+    for src, key in (
+        ("ratio", "mesh_balance_ratio"),
+        ("sync_points", "mesh_balance_sync_points"),
+        ("attributed_s", "mesh_attributed_s"),
+    ):
+        v = balance.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            n = _name(prefix, key)
+            _family(lines, n, "gauge", key)
+            lines.append(f"{n} {_fmt(v)}")
+    per_dev = balance.get("per_device_s") or []
+    if any(isinstance(v, (int, float)) for v in per_dev):
+        n = _name(prefix, "device_run_s")
+        _family(lines, n, "gauge", "device_run_s")
+        for d, v in enumerate(per_dev):
+            if isinstance(v, (int, float)):
+                lines.append(f'{n}{{device="{d}"}} {_fmt(v)}')
+    hbm = block.get("hbm") or {}
+    for src, key in (
+        ("bytes_in_use", "device_hbm_bytes_in_use"),
+        ("peak_bytes_in_use", "device_hbm_peak_bytes_in_use"),
+    ):
+        rows = [
+            (d, (stats or {}).get(src))
+            for d, stats in enumerate(hbm.get("per_device") or [])
+            if isinstance((stats or {}).get(src), (int, float))
+        ]
+        if not rows:
+            continue
+        n = _name(prefix, key)
+        _family(lines, n, "gauge", key)
+        for d, v in rows:
+            lines.append(f'{n}{{device="{d}"}} {_fmt(v)}')
+    col = block.get("collectives") or {}
+    by_op = col.get("by_op") or {}
+    if by_op:
+        for src, key in (("count", "collective_ops"), ("bytes", "collective_bytes")):
+            n = _name(prefix, key, "_total")
+            _family(lines, n, "counter", key)
+            for op, slot in sorted(by_op.items()):
+                v = (slot or {}).get(src)
+                if isinstance(v, (int, float)):
+                    lines.append(
+                        f'{n}{{op="{_escape_label(op)}"}} {_fmt(v)}'
+                    )
+    hot = col.get("hot_loop") or {}
+    for src, key in (
+        # count includes the tolerated control-plane traffic; float_count
+        # is the zero-collective contract metric an operator alerts on
+        ("count", "collective_hot_loop_ops"),
+        ("float_count", "collective_hot_loop_float_ops"),
+    ):
+        v = hot.get(src)
+        if isinstance(v, (int, float)):
+            n = _name(prefix, key, "_total")
+            _family(lines, n, "counter", key)
+            lines.append(f"{n} {_fmt(v)}")
+
+
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     """ServiceMetrics snapshot dict -> Prometheus exposition text."""
     lines: list[str] = []
@@ -284,6 +393,9 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     capacity = snapshot.get("capacity")
     if isinstance(capacity, dict):
         _capacity_lines(prefix, capacity, lines)
+    mesh = snapshot.get("mesh")
+    if isinstance(mesh, dict):
+        _mesh_lines(prefix, mesh, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
@@ -315,7 +427,7 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     for key, v in sorted(snapshot.items()):
         if key in (
             "counters", "gauges", "streams", "cost_ledger", "quality",
-            "slo", "capacity",
+            "slo", "capacity", "mesh",
         ):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):

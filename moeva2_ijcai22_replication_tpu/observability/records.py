@@ -13,9 +13,15 @@ asserts the producers keep calling it).
 from __future__ import annotations
 
 from .ledger import get_ledger
+from .mesh import mesh_block, validate_mesh
 from .quality import quality_block, validate_quality
 from .slo import validate_slo
-from .trace import Trace, TraceRecorder, device_memory_stats
+from .trace import (
+    Trace,
+    TraceRecorder,
+    all_device_memory_stats,
+    device_memory_stats,
+)
 
 #: the keys every bench / grid-report / serving-sweep record must carry.
 REQUIRED_RECORD_KEYS = ("execution", "telemetry")
@@ -31,6 +37,8 @@ def telemetry_block(
     ledger_since: dict | None = None,
     quality: dict | None = None,
     slo: dict | None = None,
+    mesh: dict | None = None,
+    mesh_since: dict | None = None,
 ) -> dict:
     """JSON-ready telemetry summary for a record: span totals (from a
     PhaseTimer), trace id + event count (from a Trace), recorder counters,
@@ -50,8 +58,38 @@ def telemetry_block(
     ``slo`` is a pre-assembled ``observability.slo.slo_block`` (stage
     latency histograms + shed attribution + saturation knee) — serving
     producers pass it (``validate_record`` enforces it on serving
-    records); batch producers have no request path and omit it."""
-    block: dict = {"hbm": device_memory_stats(device)}
+    records); batch producers have no request path and omit it.
+
+    ``mesh`` is the run's ``attacks.sharding.describe_mesh`` dict (None
+    or single-device descs are ignored); when it names more than one
+    device the block gains ``telemetry.mesh`` — per-device roofline +
+    HBM, balance ratio, collective classification — window-scoped by
+    ``mesh_since`` (a ``mesh.MESH.mark()``) the same way ``ledger_since``
+    scopes ``telemetry.cost``. ``validate_record`` requires it on any
+    record whose execution mode ran multi-device.
+
+    On a multi-device run the HBM watermark reads the RUN'S devices —
+    the first ``mesh["devices"]`` local ordinals, matching
+    ``experiments.common.build_mesh``'s prefix selection — with ``hbm``
+    = the max across them and ``hbm_devices`` the per-ordinal list; a
+    watermark over devices the run never touched would absorb foreign
+    processes' usage. Single-device records keep the pre-mesh
+    ``device_memory_stats(device)`` probe byte-for-byte."""
+    n_mesh = int((mesh or {}).get("devices") or 1)
+    stats = None
+    if n_mesh > 1:
+        try:
+            import jax
+
+            run_devices = jax.local_devices()[:n_mesh]
+        except Exception:
+            run_devices = None
+        stats = all_device_memory_stats(run_devices or None)
+    block: dict = {
+        "hbm": stats["max"] if stats else device_memory_stats(device)
+    }
+    if stats and stats.get("device_count", 0) > 1:
+        block["hbm_devices"] = stats["per_device"]
     block["quality"] = validate_quality(
         quality if quality is not None else quality_block()
     )
@@ -69,6 +107,13 @@ def telemetry_block(
     block["cost"] = (ledger if ledger is not None else get_ledger()).cost_block(
         since=ledger_since
     )
+    if mesh is not None and int(mesh.get("devices") or 1) > 1:
+        block["mesh"] = mesh_block(
+            mesh,
+            ledger=ledger,
+            ledger_since=ledger_since,
+            capture_since=mesh_since,
+        )
     return block
 
 
@@ -98,6 +143,31 @@ def validate_record(record: dict, kind: str = "record") -> dict:
             "every committed number"
         )
     validate_quality(telemetry["quality"], kind)
+    # multi-device records additionally carry the mesh block (per-device
+    # roofline + HBM, balance ratio, collective classification): a record
+    # whose own execution mode says it ran on >1 device without one is
+    # exactly the "rc=0, tail says ok" blindness this schema exists to
+    # close. Device count comes from the record's execution identity —
+    # either a full describe_mesh dict or the grid pipeline's plain
+    # mesh_devices count.
+    execution = record.get("execution")
+    devices = 0
+    if isinstance(execution, dict):
+        mesh_desc = execution.get("mesh")
+        if isinstance(mesh_desc, dict):
+            devices = int(mesh_desc.get("devices") or 0)
+        else:
+            devices = int(execution.get("mesh_devices") or 0)
+    if devices > 1:
+        if "mesh" not in telemetry:
+            raise ValueError(
+                f"{kind} record ran on {devices} devices but its telemetry "
+                "block is missing the 'mesh' sub-block: assemble it with "
+                "observability.records.telemetry_block(mesh=...) so "
+                "per-device roofline, balance, and collective attribution "
+                "travel with every multi-device record"
+            )
+        validate_mesh(telemetry["mesh"], kind)
     # serving records additionally carry the SLO block (stage histograms,
     # shed attribution, saturation knee) — the request path is the one
     # producer with a latency decomposition to report, and dropping it

@@ -348,6 +348,36 @@ def recorder_for(config: dict | None) -> TraceRecorder:
 
 
 # -- device memory watermarks -------------------------------------------------
+def all_device_memory_stats(devices=None) -> dict | None:
+    """Best-effort HBM watermarks of ALL local devices (default:
+    ``jax.local_devices()``): ``{"device_count", "per_device": [stats |
+    None per ordinal], "max": stats}`` where ``max`` is the elementwise
+    maximum over devices that exposed allocator stats — the
+    single-number watermark the pre-mesh surfaces kept reading from
+    device 0, now taken over the whole mesh. ``None`` when no device
+    exposes stats (CPU) or JAX is not initialised. Never raises."""
+    try:
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+        per_device = [device_memory_stats(d) for d in devices]
+    except Exception:
+        return None
+    present = [s for s in per_device if s]
+    if not present:
+        return None
+    max_stats = {
+        k: max(s[k] for s in present if k in s)
+        for k in {k for s in present for k in s}
+    }
+    return {
+        "device_count": len(per_device),
+        "per_device": per_device,
+        "max": max_stats,
+    }
+
+
 def device_memory_stats(device=None) -> dict | None:
     """Best-effort HBM watermark of ``device`` (default: the first visible
     device): ``{bytes_in_use, peak_bytes_in_use, ...}`` ints, or None when

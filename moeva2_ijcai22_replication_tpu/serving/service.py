@@ -46,12 +46,14 @@ from ..observability import (
     SloTracker,
     Trace,
     TraceRecorder,
+    all_device_memory_stats,
     build_identity,
     current_ledger_context,
     current_trace,
     device_memory_stats,
     get_ledger,
     maybe_span,
+    mesh_snapshot,
     sample_from_per_state,
 )
 from ..utils.config import get_dict_hash
@@ -73,13 +75,28 @@ def _record_device_span(bt, engine, traces0: int, t0: float, **extra) -> None:
     traced = engine.trace_count - traces0
     dur = time.perf_counter() - t0
     executables = list(getattr(engine, "last_run_executables", ()))
-    attrs = dict(
-        traces=int(traced),
-        hbm=device_memory_stats(
-            engine.mesh.devices.flat[0] if engine.mesh is not None else None
-        ),
-        **extra,
-    )
+    if engine.mesh is not None and engine.mesh.size > 1:
+        # mesh-backed engine: watermark every device, and stamp the device
+        # count so the Perfetto exporter fans this span onto per-device
+        # tracks (tid = ordinal) instead of stacking the mesh on one row
+        stats = all_device_memory_stats(list(engine.mesh.devices.flat))
+        attrs = dict(
+            traces=int(traced),
+            devices=int(engine.mesh.size),
+            hbm=(stats or {}).get("max"),
+            hbm_devices=(stats or {}).get("per_device"),
+            **extra,
+        )
+    else:
+        attrs = dict(
+            traces=int(traced),
+            hbm=device_memory_stats(
+                engine.mesh.devices.flat[0]
+                if engine.mesh is not None
+                else None
+            ),
+            **extra,
+        )
     if executables:
         attrs["executables"] = executables
         # roofline only on pure run spans: a device_compile span's duration
@@ -749,6 +766,11 @@ class AttackService:
             # balancer weights replicas by, and the basis ROADMAP item
             # 4's admission control prices requests against
             "capacity": self.capacity.snapshot(),
+            # mesh view: per-device HBM watermarks, balance ratio, and the
+            # collective census over every ledgered executable — a replica
+            # whose hot loop grew a collective (or whose devices skewed)
+            # shows here before it shows in throughput
+            "mesh": mesh_snapshot(),
             # shed/deadline attribution summary (full histograms stay on
             # /metrics): a replica shedding under backpressure vs losing
             # deadlines to device time reads differently here
@@ -795,6 +817,9 @@ class AttackService:
         snap["slo"] = self.slo.snapshot()
         # capacity model: JSON here, labeled capacity gauges under prom
         snap["capacity"] = self.capacity.snapshot()
+        # mesh view: device-labeled HBM/balance gauges and the collective
+        # census under prom (observability.prom._mesh_lines)
+        snap["mesh"] = mesh_snapshot()
         return snap
 
     def close(self):

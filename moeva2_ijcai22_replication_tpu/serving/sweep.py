@@ -167,6 +167,9 @@ def offered_load_sweep(
     # cost window: the record's telemetry.cost covers the sweep's own
     # dispatches (warmup compiles paid before this call stay out)
     ledger_mark = get_ledger().mark()
+    from ..observability import get_mesh_capture
+
+    mesh_mark = get_mesh_capture().mark()
     # SLO window, same discipline: stage histograms and shed counts in
     # the record cover the sweep's traffic, not the warmup's
     slo_mark = service.slo.mark()
@@ -179,6 +182,19 @@ def offered_load_sweep(
     # gates across the committed series
     knee = detect_knee(levels)
     snap = service.metrics_snapshot()
+    # mesh identity of the sweep: the first resolved domain running on a
+    # >1-device mesh (serving domains share one replica's devices) — a
+    # mesh-backed sweep then carries telemetry.mesh like any other
+    # multi-device record
+    mesh_desc = next(
+        (
+            m.get("mesh")
+            for m in service.healthz()["build"]["meshes"].values()
+            if isinstance(m.get("mesh"), dict)
+            and int(m["mesh"].get("devices") or 0) > 1
+        ),
+        None,
+    )
     return validate_record(
         {
             "bucket_menu": list(service.menu.sizes),
@@ -194,6 +210,7 @@ def offered_load_sweep(
                 "bucket_menu": list(service.menu.sizes),
                 "max_delay_s": service.batcher.max_delay_s,
                 "resolved_run_configs": snap["resolved_run_configs"],
+                "mesh": mesh_desc,
             },
             # quality: the per-domain engine-judged aggregation the service
             # collected over the sweep's MoEvA batches (empty for a pure
@@ -201,6 +218,8 @@ def offered_load_sweep(
             "telemetry": telemetry_block(
                 recorder=service.recorder,
                 ledger_since=ledger_mark,
+                mesh=mesh_desc,
+                mesh_since=mesh_mark,
                 quality=dict(
                     quality_block(judged="engine"),
                     **service.quality_snapshot(),

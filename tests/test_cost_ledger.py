@@ -854,14 +854,17 @@ class TestBenchDiffRepoCheck:
         serving SLO gate (knee QPS + p99-at-fixed-load) alongside the
         perf+quality watchdog — pre-SLO records skip as baselines, so the
         gate goes live with the first record that carries
-        ``telemetry.slo`` and every later record is held to it."""
+        ``telemetry.slo`` and every later record is held to it; ``--mesh``
+        arms the balance-ratio + hot-loop-collective gate the same way
+        (goes live with the first multi-device record carrying
+        ``telemetry.mesh``)."""
         import glob as _glob
 
         series = sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json")))
         assert len(series) >= 2
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
-             "--check", "--slo", *series],
+             "--check", "--slo", "--mesh", *series],
             capture_output=True,
             text=True,
             cwd=REPO,

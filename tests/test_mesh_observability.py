@@ -1,0 +1,1054 @@
+"""Mesh-scale observability: per-device roofline, the collective ledger,
+the sharding/transfer lint, and the balance watchdog.
+
+Units (hardware-free): the HLO collective census on synthetic text (op
+taxonomy, async start/done dedup, float-vs-control-plane byte split,
+replica-group → mesh-axis attribution), the per-device cost split
+(partitioned divides, unpartitioned honestly replicates), and the
+``MeshCapture`` balance math with mark/window discipline.
+
+Probes (emulated 8-device mesh, conftest's
+``xla_force_host_platform_device_count`` recipe): LedgeredJit entries for
+states-sharded programs carry device/partition counts, sharding
+summaries, and a collective census; single-device entries keep their
+pre-mesh JSON schema byte-stable.
+
+Lint (``tools/shard_lint.py``): the pure rules on synthetic entries, the
+injected-violation pair the acceptance criteria name — a forced
+``all_gather`` of float population data and an implicit host transfer at
+dispatch both trip — and the repo-check subprocess that lints the
+committed domains green (tier-1, next to ``bench_diff --check --slo``).
+
+Schema + surfaces: ``telemetry.mesh`` assembly and its
+``validate_record`` enforcement on multi-device records, device-labeled
+Prometheus families (HELP/TYPE on every family, label cardinality
+bounded by device ordinals), per-device Perfetto tracks, and the
+``bench_diff --mesh`` balance/contract gate.
+
+Overhead (tier-1 acceptance): mesh capture on/off shares every compile
+and dispatch and produces bit-identical results on the 8-device mesh.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from moeva2_ijcai22_replication_tpu.observability import (
+    LEDGER,
+    MESH,
+    CostLedger,
+    LedgeredJit,
+    MeshCapture,
+    mesh_block,
+    mesh_snapshot,
+    telemetry_block,
+    validate_mesh,
+    validate_record,
+)
+from moeva2_ijcai22_replication_tpu.observability.mesh import (
+    collective_axes,
+    parse_collectives,
+    per_device_cost,
+    probe_collectives,
+)
+from moeva2_ijcai22_replication_tpu.observability.prom import prometheus_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Each test sees an empty process ledger and mesh capture, both
+    enabled (engines record into the globals; other modules' runs must
+    not leak in)."""
+    LEDGER.reset()
+    LEDGER.enabled = True
+    MESH.reset()
+    MESH.enabled = True
+    yield
+    LEDGER.reset()
+    LEDGER.enabled = True
+    MESH.reset()
+    MESH.enabled = True
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("states",))
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Synthetic-LCLD artifact family (same shape as test_cost_ledger's)
+    — dataset- and hardware-free."""
+    from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_lcld,
+        synth_lcld_schema,
+    )
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    tmp = tmp_path_factory.mktemp("mesh_artifacts")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(32, cons.schema, seed=9)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=2))
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    return {
+        "pool": x,
+        "cons": cons,
+        "sur": sur,
+        "scaler": fit_minmax(
+            np.vstack([x, xl, xu]).min(0), np.vstack([x, xl, xu]).max(0)
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# HLO collective census (pure text parsing)
+# ---------------------------------------------------------------------------
+
+#: one float all-gather (iota groups), one TUPLE-result async all-gather
+#: pair (the TPU/GPU form — the "(" in the result type must not hide the
+#: op), one u32 collective-permute (list-form groups), one async
+#: all-reduce pair (must count ONCE), and a plain fusion line that must
+#: not count at all.
+_HLO = """\
+HloModule linted, entry_computation_layout={(f32[2,64]{1,0})->f32[16,64]{1,0}}
+  %fused = f32[2,64]{1,0} fusion(f32[2,64]{1,0} %x), kind=kLoop
+  %ag = f32[16,64]{1,0} all-gather(f32[2,64]{1,0} %x), replica_groups=[1,8]<=[8], dimensions={0}
+  %ags = (f32[2,64]{1,0}, f32[16,64]{1,0}) all-gather-start(f32[2,64]{1,0} %x), replica_groups=[1,8]<=[8], dimensions={0}
+  %agd = f32[16,64]{1,0} all-gather-done((f32[2,64]{1,0}, f32[16,64]{1,0}) %ags)
+  %cp = u32[2]{0} collective-permute(u32[2]{0} %k), source_target_pairs={{0,1},{1,0}}, replica_groups={{0,1,2,3},{4,5,6,7}}
+  %ars = f32[8]{0} all-reduce-start(f32[8]{0} %v), replica_groups=[1,8]<=[8], to_apply=%add
+  %ard = f32[8]{0} all-reduce-done(f32[8]{0} %ars)
+"""
+
+
+class TestParseCollectives:
+    def test_counts_ops_once_and_splits_float_payload(self):
+        col = parse_collectives(_HLO)
+        # ag + async ag + cp + ar (-done completions are the SAME ops)
+        assert col["count"] == 4
+        assert set(col["ops"]) == {
+            "all-gather",
+            "collective-permute",
+            "all-reduce",
+        }
+        assert col["ops"]["all-gather"]["count"] == 2
+        assert col["ops"]["all-reduce"]["count"] == 1
+        # bytes: result shapes — ag f32[16,64]=4096, async ag's TUPLE
+        # (f32[2,64], f32[16,64])=4608, cp u32[2]=8, ar f32[8]=32
+        assert col["ops"]["all-gather"]["bytes"] == 4096.0 + 4608.0
+        assert col["ops"]["collective-permute"]["bytes"] == 8.0
+        assert col["bytes"] == 4096.0 + 4608.0 + 8.0 + 32.0
+        # float split: the u32 permute is control-plane, not data
+        assert col["float_count"] == 3
+        assert col["float_bytes"] == 4096.0 + 4608.0 + 32.0
+
+    def test_tuple_result_async_collective_is_not_missed(self):
+        # the TPU/GPU async form: "(" of the tuple result sits BEFORE the
+        # op name — a prefix-of-first-paren parse sees zero collectives
+        # and would let a hot-loop all-gather through the lint
+        col = parse_collectives(
+            "%ags = (f32[2,64]{1,0}, f32[16,64]{1,0}) "
+            "all-gather-start(f32[2,64]{1,0} %x), replica_groups=[1,8]<=[8]\n"
+            "%agd = f32[16,64]{1,0} all-gather-done((f32[2,64]{1,0}, "
+            "f32[16,64]{1,0}) %ags)\n"
+        )
+        assert col["count"] == 1
+        assert col["float_count"] == 1
+        assert col["ops"]["all-gather"]["count"] == 1
+
+    def test_replica_groups_both_forms(self):
+        col = parse_collectives(_HLO)
+        # iota [1,8] → size 8 (ag + async ag + ar); list {{0,1,2,3},…} → 4
+        assert col["group_sizes"] == {"8": 3, "4": 1}
+
+    def test_collective_free_text_is_empty(self):
+        col = parse_collectives("%f = f32[8]{0} fusion(f32[8]{0} %x)\n")
+        assert col["count"] == 0
+        assert col["bytes"] == 0.0
+        assert col["ops"] == {}
+
+    def test_probe_degrades_to_none_without_as_text(self):
+        assert probe_collectives(object()) is None
+
+        class Raises:
+            def as_text(self):
+                raise RuntimeError("backend says no")
+
+        assert probe_collectives(Raises()) is None
+
+
+class TestPerDeviceCost:
+    def test_partitioned_cost_splits(self):
+        pd = per_device_cost(8000.0, 1600.0, partitions=8, devices=8)
+        assert pd == {
+            "devices": 8,
+            "partitions": 8,
+            "replicated": False,
+            "flops": 1000.0,
+            "bytes_accessed": 200.0,
+        }
+
+    def test_unpartitioned_cost_replicates_not_divides(self):
+        # the honest fallback: every device pays the FULL program
+        pd = per_device_cost(8000.0, None, partitions=1, devices=8)
+        assert pd["replicated"] is True
+        assert pd["flops"] == 8000.0
+        assert pd["bytes_accessed"] is None
+
+
+class TestCollectiveAxes:
+    DESC = {"devices": 8, "shape": [2, 4], "axes": ["dp", "tp"]}
+
+    def test_group_size_maps_to_unique_axis(self):
+        assert collective_axes({"4": 3}, self.DESC) == {"tp": 3}
+        assert collective_axes({"2": 1}, self.DESC) == {"dp": 1}
+
+    def test_whole_mesh_group_is_all(self):
+        assert collective_axes({"8": 2}, self.DESC) == {"all": 2}
+
+    def test_single_axis_whole_mesh_names_the_axis(self):
+        desc = {"devices": 8, "shape": [8], "axes": ["states"]}
+        assert collective_axes({"8": 2}, desc) == {"states": 2}
+
+    def test_ambiguous_size_stays_honest(self):
+        assert collective_axes({"3": 1}, self.DESC) == {"group3": 1}
+        # no mesh description at all: everything is a bare group size
+        assert collective_axes({"4": 2}, None) == {"group4": 2}
+
+
+# ---------------------------------------------------------------------------
+# balance capture
+# ---------------------------------------------------------------------------
+
+
+class TestMeshCapture:
+    def test_uniform_rows_balance_to_one(self):
+        cap = MeshCapture()
+        cap.record_balance([2.0] * 8, 4.0)
+        block = cap.balance_block()
+        assert block["devices"] == 8
+        assert block["ratio"] == 1.0
+        # SPMD lockstep: every fully-loaded device accrues the whole
+        # window's wall-clock as useful seconds
+        assert block["per_device_s"] == [4.0] * 8
+        assert block["sync_points"] == 1
+        assert block["attributed_s"] == 4.0
+
+    def test_skew_attributes_by_live_row_share(self):
+        cap = MeshCapture()
+        # device 0 carries all live rows: everyone pays the wall-clock,
+        # only device 0 does useful work -> ratio 1/8
+        cap.record_balance([4, 0, 0, 0, 0, 0, 0, 0], 2.0)
+        block = cap.balance_block()
+        assert block["per_device_s"][0] == 2.0
+        assert sum(block["per_device_s"][1:]) == 0.0
+        assert block["ratio"] == pytest.approx(0.125)
+
+    def test_mark_scopes_a_window(self):
+        cap = MeshCapture()
+        cap.record_balance([1, 1], 10.0)
+        mark = cap.mark()
+        cap.record_balance([2, 0], 3.0)
+        window = cap.balance_block(since=mark)
+        assert window["sync_points"] == 1
+        assert window["attributed_s"] == 3.0
+        assert window["per_device_s"] == [3.0, 0.0]
+        assert window["ratio"] == pytest.approx(0.5)
+        # cumulative view untouched
+        assert cap.balance_block()["sync_points"] == 2
+
+    def test_disabled_and_degenerate_inputs_are_noops(self):
+        cap = MeshCapture(enabled=False)
+        cap.record_balance([1, 1], 5.0)
+        assert cap.balance_block()["sync_points"] == 0
+        cap = MeshCapture()
+        cap.record_balance([], 5.0)  # no devices
+        cap.record_balance([1, 1], 0.0)  # no duration
+        cap.record_balance([0, 0], 5.0)  # nothing live
+        cap.record_balance("junk", 5.0)  # never raises
+        block = cap.balance_block()
+        assert block["sync_points"] == 0
+        assert block["ratio"] is None
+
+
+# ---------------------------------------------------------------------------
+# compiled-executable probes on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+class TestCompiledProbes:
+    def test_sharded_program_entry_carries_mesh_payload(self, mesh8):
+        led = CostLedger()
+        x = jax.device_put(
+            jnp.ones((16, 8), jnp.float32), NamedSharding(mesh8, P("states"))
+        )
+        lj = LedgeredJit(
+            jax.jit(lambda x: x * 2 + 1), producer="pgd_attack", ledger=led
+        )
+        lj(x)
+        (entry,) = led.entries()
+        assert entry.devices == 8
+        assert entry.partitions == 8
+        assert entry.sharding["in"]["sharded"] == 1
+        assert entry.sharding["in"]["replicated_bytes"] == 0
+        # elementwise states-sharded program: zero collectives
+        assert entry.collectives is not None
+        assert entry.collectives["count"] == 0
+        d = entry.as_dict()
+        assert d["mesh"]["devices"] == 8
+        assert d["mesh"]["per_device"]["replicated"] is False
+        if entry.flops is not None:
+            assert d["mesh"]["per_device"]["flops"] == pytest.approx(
+                entry.flops / 8
+            )
+
+    def test_single_device_entry_schema_is_unchanged(self):
+        led = CostLedger()
+        lj = LedgeredJit(
+            jax.jit(lambda x: x + 1), producer="pgd_attack", ledger=led
+        )
+        lj(jnp.ones((4, 4), jnp.float32))
+        (entry,) = led.entries()
+        assert entry.devices == 1
+        # the pre-mesh ledger JSON stays byte-stable for 1-device programs
+        assert "mesh" not in entry.as_dict()
+
+    def test_forced_all_gather_shows_in_census(self, mesh8):
+        led = CostLedger()
+        x = jax.device_put(
+            jnp.ones((16, 64), jnp.float32), NamedSharding(mesh8, P("states"))
+        )
+
+        def bad(x):
+            g = jax.lax.with_sharding_constraint(
+                x * 2.0, NamedSharding(mesh8, P())
+            )
+            return g - g.mean()
+
+        lj = LedgeredJit(jax.jit(bad), producer="moeva_segment", ledger=led)
+        lj(x)
+        (entry,) = led.entries()
+        col = entry.collectives
+        assert col is not None and col["count"] >= 1
+        # float population data crossed devices — the contract violation
+        assert col["float_count"] >= 1
+        assert col["float_bytes"] > 0
+
+    def test_capture_off_skips_the_probe(self, mesh8):
+        MESH.enabled = False
+        led = CostLedger()
+        x = jax.device_put(
+            jnp.ones((16, 8), jnp.float32), NamedSharding(mesh8, P("states"))
+        )
+        lj = LedgeredJit(
+            jax.jit(lambda x: x * 3), producer="pgd_attack", ledger=led
+        )
+        lj(x)
+        (entry,) = led.entries()
+        assert entry.devices == 1  # no payload recorded
+        assert "mesh" not in entry.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# shard lint: pure rules, injected violations, repo check
+# ---------------------------------------------------------------------------
+
+
+def _entry(**kw):
+    base = dict(
+        producer="moeva_segment",
+        key="k#1",
+        devices=8,
+        partitions=8,
+        sharding=None,
+        collectives=None,
+    )
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+class TestLintRules:
+    @pytest.fixture(scope="class")
+    def shard_lint(self):
+        return _load_tool("shard_lint")
+
+    def test_single_device_entries_lint_clean(self, shard_lint):
+        assert shard_lint.lint_entry(_entry(devices=1)) == []
+
+    def test_float_collective_in_hot_loop_trips(self, shard_lint):
+        col = {"count": 1, "bytes": 4096.0, "float_count": 1,
+               "float_bytes": 4096.0}
+        out = shard_lint.lint_entry(_entry(collectives=col))
+        assert [v["rule"] for v in out] == ["hot_loop_float_collective"]
+
+    def test_control_plane_collectives_are_tolerated_but_bounded(
+        self, shard_lint
+    ):
+        small = {"count": 2, "bytes": 4500.0, "float_count": 0,
+                 "float_bytes": 0.0}
+        assert shard_lint.lint_entry(_entry(collectives=small)) == []
+        huge = {"count": 2, "bytes": 2.0 * (1 << 20), "float_count": 0,
+                "float_bytes": 0.0}
+        out = shard_lint.lint_entry(_entry(collectives=huge))
+        assert [v["rule"] for v in out] == ["hot_loop_collective_bytes"]
+
+    def test_gate_producer_is_not_hot_loop(self, shard_lint):
+        col = {"count": 1, "bytes": 4096.0, "float_count": 1,
+               "float_bytes": 4096.0}
+        out = shard_lint.lint_entry(
+            _entry(producer="moeva_success", collectives=col)
+        )
+        # not hot-loop, but still an attack producer: only replication
+        # rules could apply, and partitions=8 is sharded
+        assert out == []
+
+    def test_fully_replicated_program_trips(self, shard_lint):
+        out = shard_lint.lint_entry(_entry(partitions=1))
+        assert [v["rule"] for v in out] == ["fully_replicated_program"]
+
+    def test_replicated_large_output_trips(self, shard_lint):
+        sharding = {
+            "in": {
+                "sharded_bytes": 8192,
+                "largest": {"bytes": 8192, "sharded": True, "spec": "P('states',)"},
+            },
+            "out": {
+                "largest": {"bytes": 8192, "sharded": False, "spec": "P()"},
+            },
+        }
+        out = shard_lint.lint_entry(_entry(sharding=sharding))
+        assert [v["rule"] for v in out] == ["replicated_large_output"]
+
+    def test_dispatch_error_classification(self, shard_lint):
+        # only transfer-guard trips are the sharding contract; an
+        # unrelated engine crash must not masquerade as one
+        guard = RuntimeError(
+            "INVALID_ARGUMENT: Disallowed host-to-device transfer: "
+            "aval=ShapedArray(float32[])"
+        )
+        assert shard_lint.classify_dispatch_error(guard) == "host_transfer"
+        assert (
+            shard_lint.classify_dispatch_error(ValueError("bad shape"))
+            == "engine_error"
+        )
+
+    def test_small_replicated_output_is_fine(self, shard_lint):
+        # a scalar/consensus output coming back replicated is normal
+        sharding = {
+            "in": {
+                "sharded_bytes": 8192,
+                "largest": {"bytes": 8192, "sharded": True, "spec": "P('states',)"},
+            },
+            "out": {"largest": {"bytes": 32, "sharded": False, "spec": "P()"}},
+        }
+        assert shard_lint.lint_entry(_entry(sharding=sharding)) == []
+
+
+class TestLintInjected:
+    """The acceptance pair: the lint must FAIL on an injected all_gather
+    and on an injected host transfer — and pass a clean sharded program."""
+
+    @pytest.fixture(scope="class")
+    def shard_lint(self):
+        return _load_tool("shard_lint")
+
+    def test_injected_all_gather_trips(self, shard_lint, mesh8):
+        violations = shard_lint.injected_collective_violations(mesh8)
+        assert violations, "forced all-gather must violate the contract"
+        assert any(
+            v["rule"] in ("hot_loop_float_collective",
+                          "hot_loop_collective_bytes",
+                          "replicated_large_output")
+            for v in violations
+        )
+
+    def test_injected_host_transfer_trips(self, shard_lint, mesh8):
+        violations = shard_lint.injected_transfer_violation(mesh8)
+        assert [v["rule"] for v in violations] == ["host_transfer"]
+        assert "pgd_attack" in violations[0]["producer"]
+
+    def test_clean_sharded_program_passes(self, shard_lint, mesh8):
+        led = CostLedger()
+        x = jax.device_put(
+            jnp.ones((16, 8), jnp.float32), NamedSharding(mesh8, P("states"))
+        )
+        lj = LedgeredJit(
+            jax.jit(lambda x: x * 2 + 1), producer="pgd_attack", ledger=led
+        )
+        lj(x)
+        assert shard_lint.lint_entries(led.entries()) == []
+
+    def test_transfer_guard_restores_previous_mode(self, shard_lint, mesh8):
+        from moeva2_ijcai22_replication_tpu.observability import ledger as lmod
+
+        assert lmod._dispatch_transfer_guard is None
+        shard_lint.injected_transfer_violation(mesh8)
+        assert lmod._dispatch_transfer_guard is None
+
+
+class TestShardLintRepoCheck:
+    def test_committed_domains_lint_green_and_selftest_trips(self):
+        """The repo check tier-1 runs (wired next to ``bench_diff --check
+        --slo --mesh``): the committed attack programs must compile clean
+        on the emulated 8-device mesh — zero hot-loop data collectives,
+        no implicit transfers, no unintended replication — and the
+        selftest proves the lint still trips on injected violations."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "shard_lint.py"),
+             "--check", "--selftest", "--json"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=540,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload["ok"] is True
+        assert payload["violations"] == []
+        assert "lcld_synth" in payload["linted"]
+        assert all(payload["selftest"].values())
+
+
+# ---------------------------------------------------------------------------
+# telemetry.mesh assembly + record schema
+# ---------------------------------------------------------------------------
+
+_DESC = {"devices": 8, "shape": [8], "axes": ["states"]}
+
+
+def _seeded_ledger():
+    """A ledger holding one hot-loop executable with a known cost and a
+    float all-gather census, dispatched twice."""
+    led = CostLedger()
+    col = parse_collectives(_HLO)
+    entry = led.record_compile(
+        producer="moeva_segment",
+        identity={},
+        backend="cpu",
+        compile_s=0.1,
+        cost={"flops": 800.0, "bytes_accessed": 1600.0},
+        memory=None,
+        mesh_probe={
+            "devices": 8,
+            "partitions": 8,
+            "sharding": {"devices": 8, "partitions": 8, "in": {}, "out": None},
+            "collectives": col,
+        },
+    )
+    led.record_dispatch(entry.key)
+    led.record_dispatch(entry.key)
+    return led, col
+
+
+class TestMeshBlock:
+    def test_block_joins_cost_balance_and_collectives(self):
+        led, col = _seeded_ledger()
+        cap = MeshCapture()
+        cap.record_balance([2.0] * 8, 4.0)
+        block = mesh_block(_DESC, ledger=led, capture=cap)
+        assert block["enabled"] is True
+        assert block["devices"] == 8
+        assert len(block["per_device"]) == 8
+        # per-device flops: 800 flops * 2 dispatches / 8 partitions
+        assert block["per_device"][0]["flops"] == pytest.approx(200.0)
+        assert block["per_device"][0]["run_s"] == 4.0
+        assert block["per_device"][0]["achieved_flops_s"] == pytest.approx(
+            200.0 / 4.0
+        )
+        assert block["balance"]["ratio"] == 1.0
+        # census is dispatch-weighted; every op here is hot-loop
+        assert block["collectives"]["count"] == col["count"] * 2
+        assert block["collectives"]["hot_loop"]["float_count"] == (
+            col["float_count"] * 2
+        )
+        # size-8 groups on the 8-device states mesh attribute to the axis
+        assert block["collectives"]["by_axis"]["states"] > 0
+        cls = block["classification"]
+        assert cls["comm_bytes"] == col["bytes"] * 2
+        assert 0 < cls["comm_fraction"] < 1
+        assert validate_mesh(block) is block
+
+    def test_single_device_entries_stay_out_of_per_device_cost(self):
+        """A mixed window (mesh-backed domain + single-device domain in
+        one ledger) must not charge the single-device executables' cost
+        to every mesh device."""
+        led, _ = _seeded_ledger()
+        solo = led.record_compile(
+            producer="pgd_attack",
+            identity={},
+            backend="cpu",
+            compile_s=0.1,
+            cost={"flops": 1e9, "bytes_accessed": 1e9},
+            memory=None,
+        )
+        led.record_dispatch(solo.key)
+        cap = MeshCapture()
+        cap.record_balance([2.0] * 8, 4.0)
+        block = mesh_block(_DESC, ledger=led, capture=cap)
+        # still only the mesh entry's 800 flops * 2 dispatches / 8 parts
+        assert block["per_device"][0]["flops"] == pytest.approx(200.0)
+
+    def test_capture_off_degrades_to_identity_and_validates(self):
+        cap = MeshCapture(enabled=False)
+        block = mesh_block(_DESC, capture=cap)
+        assert block == {
+            "enabled": False,
+            "devices": 8,
+            "shape": [8],
+            "axes": ["states"],
+        }
+        assert validate_mesh(block) is block
+
+    def test_validate_mesh_rejects_gutted_blocks(self):
+        with pytest.raises(ValueError, match="telemetry.mesh"):
+            validate_mesh({"enabled": True, "devices": 8})
+        with pytest.raises(ValueError, match="must be a dict"):
+            validate_mesh("mesh happened")
+
+    def test_mesh_snapshot_process_view(self):
+        led, col = _seeded_ledger()
+        cap = MeshCapture()
+        cap.record_balance([1.0] * 8, 2.0)
+        snap = mesh_snapshot(ledger=led, capture=cap)
+        assert snap["enabled"] is True
+        assert snap["device_count"] == len(jax.devices())
+        assert snap["balance"]["ratio"] == 1.0
+        assert snap["collectives"]["count"] == col["count"] * 2
+
+
+class TestRecordSchema:
+    def test_multi_device_record_requires_mesh_block(self):
+        rec = {
+            "execution": {"mesh": dict(_DESC)},
+            "telemetry": telemetry_block(),
+        }
+        rec["telemetry"].pop("mesh", None)
+        with pytest.raises(ValueError, match="missing the 'mesh'"):
+            validate_record(rec, "bench")
+
+    def test_mesh_devices_count_alone_also_enforces(self):
+        rec = {
+            "execution": {"mesh_devices": 8},
+            "telemetry": telemetry_block(),
+        }
+        with pytest.raises(ValueError, match="ran on 8 devices"):
+            validate_record(rec, "grid")
+
+    def test_telemetry_block_attaches_and_validates(self):
+        rec = {
+            "execution": {"mesh": dict(_DESC)},
+            "telemetry": telemetry_block(mesh=dict(_DESC)),
+        }
+        assert validate_record(rec, "bench") is rec
+        assert rec["telemetry"]["mesh"]["devices"] == 8
+
+    def test_single_device_records_stay_unchanged(self):
+        block = telemetry_block(mesh=None)
+        assert "mesh" not in block
+        block = telemetry_block(mesh={"devices": 1})
+        assert "mesh" not in block
+        rec = {"execution": {"mesh": None}, "telemetry": telemetry_block()}
+        assert validate_record(rec, "bench") is rec
+
+    def test_capture_off_multi_device_record_still_validates(self):
+        MESH.enabled = False
+        rec = {
+            "execution": {"mesh": dict(_DESC)},
+            "telemetry": telemetry_block(mesh=dict(_DESC)),
+        }
+        assert validate_record(rec, "bench") is rec
+        assert rec["telemetry"]["mesh"]["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition: device-labeled families
+# ---------------------------------------------------------------------------
+
+
+def _prom_families(text: str):
+    families, helped, typed = set(), set(), set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+        elif line and not line.startswith("#"):
+            families.add(line.split("{")[0].split(" ")[0])
+    return families, helped, typed
+
+
+class TestPromMesh:
+    def _text(self):
+        led, _ = _seeded_ledger()
+        cap = MeshCapture()
+        cap.record_balance([1.0, 1.0, 1.0, 1.0, 0.0, 0.0, 0.0, 0.0], 2.0)
+        snap = mesh_snapshot(ledger=led, capture=cap)
+        return prometheus_text({"mesh": snap})
+
+    def test_every_family_has_help_and_type(self):
+        text = self._text()
+        families, helped, typed = _prom_families(text)
+        assert families, "mesh exposition must emit families"
+        assert families - helped == set(), f"no HELP: {families - helped}"
+        assert families - typed == set(), f"no TYPE: {families - typed}"
+
+    def test_device_labels_are_bounded_ordinals(self):
+        text = self._text()
+        devices = {
+            line.split('device="')[1].split('"')[0]
+            for line in text.splitlines()
+            if 'device="' in line
+        }
+        assert devices  # per-device balance gauges present
+        # cardinality bounded by local device ordinals, never device ids
+        assert devices <= {str(d) for d in range(len(jax.devices()))}
+        assert 'moeva2_device_run_s{device="0"}' in text
+
+    def test_balance_and_collective_families(self):
+        text = self._text()
+        assert "moeva2_mesh_balance_ratio 0.5" in text
+        assert "# TYPE moeva2_collective_ops_total counter" in text
+        assert 'moeva2_collective_ops_total{op="all-gather"}' in text
+        assert "moeva2_collective_hot_loop_ops_total" in text
+        # the contract metric an operator alerts on is the FLOAT count
+        # (the total legitimately includes control-plane traffic)
+        assert "moeva2_collective_hot_loop_float_ops_total" in text
+        assert "must be 0" in text.split(
+            "collective_hot_loop_float_ops"
+        )[1].splitlines()[0]
+
+    def test_ledger_per_device_gauges(self):
+        led, _ = _seeded_ledger()
+        text = prometheus_text({"cost_ledger": led.cost_block()})
+        assert "moeva2_executable_per_device_flops{" in text
+        families, helped, typed = _prom_families(text)
+        assert families - helped == set()
+        assert families - typed == set()
+
+
+# ---------------------------------------------------------------------------
+# perfetto: per-device tracks
+# ---------------------------------------------------------------------------
+
+
+class TestPerfettoDeviceTracks:
+    def test_multi_device_run_span_fans_out_per_ordinal(self):
+        from moeva2_ijcai22_replication_tpu.observability.export import (
+            to_chrome_trace,
+        )
+
+        hbm = [{"bytes_in_use": 10 * (d + 1)} for d in range(4)]
+        doc = to_chrome_trace(
+            [
+                {"kind": "meta", "t0_wall": 5.0},
+                {
+                    "kind": "span",
+                    "name": "device_run",
+                    "trace": "req-1",
+                    "span": "s1",
+                    "ts": 0.5,
+                    "dur": 0.25,
+                    "attrs": {"devices": 4, "hbm_devices": hbm},
+                },
+            ]
+        )
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        # tid 0 carries the trace's other spans — devices offset past it
+        assert [e["tid"] for e in xs] == [1, 2, 3, 4]
+        assert all(e["name"] == "device_run" for e in xs)
+        assert all(e["dur"] == 250000.0 for e in xs)
+        assert [e["args"]["device"] for e in xs] == [0, 1, 2, 3]
+        assert xs[2]["args"]["hbm"] == {"bytes_in_use": 30}
+        # named per-device tracks
+        names = [
+            e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        ]
+        assert [n["args"]["name"] for n in names] == [
+            f"device {d}" for d in range(4)
+        ]
+
+    def test_single_device_span_renders_exactly_as_before(self):
+        from moeva2_ijcai22_replication_tpu.observability.export import (
+            to_chrome_trace,
+        )
+
+        events = [
+            {
+                "kind": "span",
+                "name": "device_run",
+                "trace": "req-1",
+                "span": "s1",
+                "ts": 0.5,
+                "dur": 0.25,
+                "attrs": {"traces": 1},
+            }
+        ]
+        doc = to_chrome_trace(events)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 1 and xs[0]["tid"] == 0
+        assert "device" not in xs[0]["args"]
+        assert not any(
+            e["name"] == "thread_name" for e in doc["traceEvents"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# bench_diff --mesh
+# ---------------------------------------------------------------------------
+
+
+def _bench_rec(path, ratio=None, hot_float=None, mesh=True):
+    rec = {"steady_s": 10.0, "execution": {"n_states": 64, "n_gen": 100}}
+    if mesh:
+        balance = {"ratio": ratio, "sync_points": 3, "attributed_s": 5.0}
+        rec["telemetry"] = {
+            "mesh": {
+                "enabled": True,
+                "devices": 8,
+                "balance": balance,
+                "collectives": {
+                    "hot_loop": {"count": 4, "float_count": hot_float or 0}
+                },
+            }
+        }
+    path.write_text(json.dumps(rec))
+    return str(path)
+
+
+class TestBenchDiffMesh:
+    @pytest.fixture(scope="class")
+    def bench_diff(self):
+        return _load_tool("bench_diff")
+
+    def test_small_ratio_drop_passes(self, bench_diff, tmp_path):
+        a = _bench_rec(tmp_path / "a.json", ratio=0.9)
+        b = _bench_rec(tmp_path / "b.json", ratio=0.85)
+        assert bench_diff.main([a, b, "--mesh"]) == 0
+
+    def test_large_ratio_drop_fails_only_under_mesh(
+        self, bench_diff, tmp_path
+    ):
+        a = _bench_rec(tmp_path / "a.json", ratio=0.9)
+        b = _bench_rec(tmp_path / "b.json", ratio=0.5)  # 44% drop
+        assert bench_diff.main([a, b]) == 0  # gate is opt-in
+        assert bench_diff.main([a, b, "--mesh"]) == 1
+        assert bench_diff.main(
+            [a, b, "--mesh", "--mesh-threshold", "0.6"]
+        ) == 0
+
+    def test_any_hot_loop_float_collective_growth_fails(
+        self, bench_diff, tmp_path
+    ):
+        a = _bench_rec(tmp_path / "a.json", ratio=0.9, hot_float=0)
+        b = _bench_rec(tmp_path / "b.json", ratio=0.9, hot_float=1)
+        # the contract gate has NO tolerance to widen
+        assert bench_diff.main([a, b, "--mesh"]) == 1
+        assert bench_diff.main(
+            [a, b, "--mesh", "--mesh-threshold", "100"]
+        ) == 1
+        assert bench_diff.main([b, a, "--mesh"]) == 0  # shrinking is fine
+
+    def test_losing_mesh_capture_fails(self, bench_diff, tmp_path):
+        a = _bench_rec(tmp_path / "a.json", ratio=0.9)
+        b = _bench_rec(tmp_path / "b.json", mesh=False)
+        assert bench_diff.main([a, b, "--mesh"]) == 1
+
+    def test_pre_mesh_baselines_skip(self, bench_diff, tmp_path):
+        a = _bench_rec(tmp_path / "a.json", mesh=False)  # pre-mesh record
+        b = _bench_rec(tmp_path / "b.json", ratio=0.9)
+        assert bench_diff.main([a, b, "--mesh"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# engines on the mesh: ledger evidence + the on/off overhead smoke
+# ---------------------------------------------------------------------------
+
+
+class TestEngineMeshEvidence:
+    def test_pgd_entry_carries_per_device_roofline_and_census(
+        self, artifacts, mesh8
+    ):
+        from moeva2_ijcai22_replication_tpu.attacks.pgd import ConstrainedPGD
+
+        pgd = ConstrainedPGD(
+            classifier=artifacts["sur"],
+            constraints=artifacts["cons"],
+            scaler=artifacts["scaler"],
+            max_iter=3,
+            mesh=mesh8,
+        )
+        xs = np.asarray(artifacts["scaler"].transform(artifacts["pool"][:16]))
+        y = np.asarray(artifacts["sur"].predict_proba(xs)).argmax(-1)
+        pgd.generate(xs, y)
+        (entry,) = [e for e in LEDGER.entries() if e.producer == "pgd_attack"]
+        assert entry.devices == 8
+        assert entry.partitions == 8
+        assert entry.collectives is not None
+        # the hot loop moves no floating-point payload between devices
+        assert entry.collectives["float_count"] == 0
+        d = entry.as_dict()
+        assert d["mesh"]["per_device"]["flops"] is not None
+        # balance: PGD runs every row to the full budget — uniform
+        block = MESH.balance_block()
+        assert block["sync_points"] == 1
+        assert block["ratio"] == 1.0
+
+    def test_moeva_entries_carry_mesh_and_balance(self, artifacts, mesh8):
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+
+        moeva = Moeva2(
+            classifier=artifacts["sur"],
+            constraints=artifacts["cons"],
+            ml_scaler=artifacts["scaler"],
+            norm=2,
+            n_gen=4,
+            n_pop=8,
+            n_offsprings=4,
+            seed=3,
+            mesh=mesh8,
+        )
+        moeva.generate(artifacts["pool"][:16], 1)
+        by_producer = {e.producer: e for e in LEDGER.entries()}
+        assert {"moeva_init", "moeva_segment"} <= set(by_producer)
+        for producer in ("moeva_init", "moeva_segment"):
+            e = by_producer[producer]
+            assert e.devices == 8, producer
+            assert e.partitions == 8, producer
+            assert e.collectives is not None, producer
+            assert e.collectives["float_count"] == 0, producer
+            assert e.as_dict()["mesh"]["per_device"]["flops"] is not None
+        block = MESH.balance_block()
+        assert block["sync_points"] >= 1
+        assert block["ratio"] == 1.0  # strict mode: every row live
+
+    def test_balance_survives_cost_ledger_off(self, artifacts, mesh8):
+        """The knobs are independent: cost_ledger off must not silently
+        drop the MoEvA balance windows (they need only wall-clock and the
+        engine's own segment log)."""
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+
+        LEDGER.enabled = False
+        moeva = Moeva2(
+            classifier=artifacts["sur"],
+            constraints=artifacts["cons"],
+            ml_scaler=artifacts["scaler"],
+            norm=2,
+            n_gen=4,
+            n_pop=8,
+            n_offsprings=4,
+            seed=3,
+            mesh=mesh8,
+        )
+        moeva.generate(artifacts["pool"][:16], 1)
+        assert not LEDGER.entries()
+        block = MESH.balance_block()
+        assert block["sync_points"] >= 1
+        assert block["ratio"] == 1.0
+
+    def test_mesh_telemetry_record_end_to_end(self, artifacts, mesh8):
+        """The MULTICHIP-record shape: run the attack, assemble a record
+        through telemetry_block(mesh=...), and validate it — per-device
+        roofline, balance, and collective attribution all present."""
+        from moeva2_ijcai22_replication_tpu.attacks.sharding import (
+            describe_mesh,
+        )
+        from moeva2_ijcai22_replication_tpu.attacks.pgd import ConstrainedPGD
+
+        ledger_mark = LEDGER.mark()
+        mesh_mark = MESH.mark()
+        pgd = ConstrainedPGD(
+            classifier=artifacts["sur"],
+            constraints=artifacts["cons"],
+            scaler=artifacts["scaler"],
+            max_iter=3,
+            mesh=mesh8,
+        )
+        xs = np.asarray(artifacts["scaler"].transform(artifacts["pool"][:16]))
+        y = np.asarray(artifacts["sur"].predict_proba(xs)).argmax(-1)
+        pgd.generate(xs, y)
+        desc = describe_mesh(mesh8)
+        rec = {
+            "execution": {"mesh": desc, "n_states": 16},
+            "telemetry": telemetry_block(
+                ledger_since=ledger_mark, mesh=desc, mesh_since=mesh_mark
+            ),
+        }
+        assert validate_record(rec, "multichip") is rec
+        mesh_tel = rec["telemetry"]["mesh"]
+        assert mesh_tel["devices"] == 8
+        assert len(mesh_tel["per_device"]) == 8
+        assert mesh_tel["per_device"][0]["flops"] is not None
+        assert mesh_tel["balance"]["ratio"] == 1.0
+        assert mesh_tel["collectives"]["hot_loop"]["float_count"] == 0
+        assert json.loads(json.dumps(rec, default=str))
+
+
+class TestMeshOverheadSmoke:
+    def test_mesh_capture_toggle_zero_extra_compiles_bit_identical(
+        self, artifacts, mesh8
+    ):
+        """Tier-1 acceptance smoke: mesh capture on/off shares every
+        compile and dispatch and produces bit-identical results."""
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+
+        def run():
+            m = Moeva2(
+                classifier=artifacts["sur"],
+                constraints=artifacts["cons"],
+                ml_scaler=artifacts["scaler"],
+                norm=2,
+                n_gen=4,
+                n_pop=8,
+                n_offsprings=4,
+                seed=17,
+                mesh=mesh8,
+            )
+            res = m.generate(artifacts["pool"][:16], 1)
+            return res, m
+
+        MESH.enabled = True
+        res_on, m_on = run()
+        assert MESH.balance_block()["sync_points"] >= 1
+
+        MESH.reset()
+        MESH.enabled = False
+        res_off, m_off = run()
+        # capture off: zero balance bookkeeping, zero mesh payloads
+        assert MESH.balance_block()["sync_points"] == 0
+
+        # bit-identical numerics
+        np.testing.assert_array_equal(res_on.x_gen, res_off.x_gen)
+        np.testing.assert_array_equal(res_on.f, res_off.f)
+        # zero extra compiles/dispatches either way
+        assert m_on.trace_count == m_off.trace_count
+        assert m_on._jit_init.calls == m_off._jit_init.calls
+        assert m_on._jit_segment.calls == m_off._jit_segment.calls

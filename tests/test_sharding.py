@@ -1,0 +1,94 @@
+"""``attacks/sharding.py``: the states-axis sharding contract itself.
+
+The module every mesh-backed engine routes placements through had no
+dedicated tests — its divisibility contract, its replicated-vs-sharded
+placements, and the JSON mesh identity every committed record embeds are
+pinned here on the emulated 8-device CPU mesh (conftest forces
+``xla_force_host_platform_device_count=8``).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from moeva2_ijcai22_replication_tpu.attacks.sharding import (
+    describe_mesh,
+    shard_states_args,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("states",))
+
+
+class TestShardStatesArgs:
+    def test_divisibility_violation_raises_with_remedy(self, mesh):
+        bad = jnp.ones((10, 4), jnp.float32)  # 10 % 8 != 0
+        with pytest.raises(ValueError, match="divisible by the mesh size"):
+            shard_states_args(mesh, "states", (), (bad,))
+        # the error must name the remedy the runners use
+        with pytest.raises(ValueError, match="pad_states"):
+            shard_states_args(mesh, "states", (), (bad,))
+
+    def test_sharded_arrays_split_leading_axis_over_devices(self, mesh):
+        x = jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4)
+        _, (xs,) = shard_states_args(mesh, "states", (), (x,))
+        assert xs.sharding == NamedSharding(mesh, P("states"))
+        shards = xs.addressable_shards
+        assert len(shards) == 8
+        # each device owns a contiguous 2-row slab, in ordinal order
+        for shard in shards:
+            assert shard.data.shape == (2, 4)
+        np.testing.assert_array_equal(np.asarray(xs), np.asarray(x))
+
+    def test_replicated_pytrees_land_on_every_device_in_full(self, mesh):
+        params = {"w": jnp.ones((3, 5)), "b": jnp.zeros((5,))}
+        key = jax.random.PRNGKey(0)
+        x = jnp.ones((8, 4), jnp.float32)
+        (params_r, key_r), (xs,) = shard_states_args(
+            mesh, "states", (params, key), (x,)
+        )
+        repl = NamedSharding(mesh, P())
+        assert key_r.sharding == repl
+        for leaf in jax.tree_util.tree_leaves(params_r):
+            assert leaf.sharding == repl
+            shards = leaf.addressable_shards
+            assert len(shards) == 8
+            # replication: every device holds the FULL array
+            for shard in shards:
+                assert shard.data.shape == leaf.shape
+        # structures are preserved
+        assert set(params_r) == {"w", "b"}
+        assert xs.shape == x.shape
+
+    def test_multiple_sharded_arrays_share_the_placement(self, mesh):
+        a = jnp.ones((8, 3), jnp.float32)
+        b = jnp.zeros((8, 7, 2), jnp.float32)
+        _, (a_s, b_s) = shard_states_args(mesh, "states", (), (a, b))
+        for arr in (a_s, b_s):
+            assert arr.sharding == NamedSharding(mesh, P("states"))
+            assert arr.addressable_shards[0].data.shape[0] == 1
+
+
+class TestDescribeMesh:
+    def test_none_mesh_describes_as_none(self):
+        assert describe_mesh(None) is None
+
+    def test_json_round_trip(self, mesh):
+        desc = describe_mesh(mesh)
+        assert desc == {"devices": 8, "shape": [8], "axes": ["states"]}
+        # every committed record embeds this dict: it must survive JSON
+        # byte-exactly (plain ints/strs, no numpy scalars)
+        assert json.loads(json.dumps(desc)) == desc
+
+    def test_multi_axis_mesh(self):
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        m = Mesh(devs, ("dp", "tp"))
+        desc = describe_mesh(m)
+        assert desc == {"devices": 8, "shape": [2, 4], "axes": ["dp", "tp"]}
+        assert json.loads(json.dumps(desc)) == desc

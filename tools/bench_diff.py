@@ -13,6 +13,7 @@ bad direction — runnable standalone or as the repo check wired into tier-1
     python tools/bench_diff.py --check                         # globs BENCH_r*.json
     python tools/bench_diff.py --check --threshold 0.4 ...
     python tools/bench_diff.py --check --slo ...               # + serving SLO gate
+    python tools/bench_diff.py --check --mesh ...              # + mesh balance gate
     python tools/bench_diff.py --check --json ...              # + CI JSON line
 
 Quality metrics: records carrying a ``telemetry.quality`` (and/or
@@ -58,6 +59,17 @@ serving record with NO slo block while any baseline carries one fails —
 losing SLO capture would disarm this gate exactly like losing quality
 capture disarms that one. Pre-SLO records (r01–r06) skip as baselines.
 
+Mesh metrics (``--mesh``): records carrying a ``telemetry.mesh`` block
+(any record whose execution ran on >1 device) expose the per-device
+balance ratio (mean/max useful run seconds; 1.0 = perfectly balanced)
+and the hot-loop float-collective count. With ``--mesh``, a balance-ratio
+drop past ``--mesh-threshold`` (relative, default 0.25) fails the check,
+and ANY growth in hot-loop float collectives fails outright — that one
+is the zero-collective states-sharding contract, not a perf number, so
+there is no tolerance to tune. Baseline-skip semantics match ``--slo``:
+pre-mesh (or single-device) records skip as baselines, but a latest
+record that LOST mesh capture while any baseline carries it fails.
+
 Records may be bare bench JSON or the committed driver wrapper
 ``{"n", "cmd", "rc", "parsed"}``; wrappers with a non-zero rc or an
 empty payload are skipped (a crashed bench is not evidence of a
@@ -84,6 +96,12 @@ DEFAULT_QUALITY_THRESHOLD = 0.10
 #: relative SLO regression (knee-QPS drop / fixed-load-p99 increase)
 #: that fails the check under --slo (see module docstring).
 DEFAULT_SLO_THRESHOLD = 0.5
+
+#: relative balance-ratio drop that fails the check under --mesh. The
+#: ratio is mean/max useful seconds in [0, 1]; run-to-run movement comes
+#: only from early-exit timing jitter reshuffling which devices park
+#: first, well inside 25% at the committed shapes.
+DEFAULT_MESH_THRESHOLD = 0.25
 
 #: o-columns tracked at each interior budget: o2 (misclassified) and o7
 #: (the full constrained-adversarial criterion) — the two the round-5
@@ -291,12 +309,37 @@ def _slo_degraded(rec: dict) -> set[str]:
     return degraded
 
 
+def _mesh_points(rec: dict) -> dict[str, float]:
+    """Every mesh metric this record's ``telemetry.mesh`` block exposes
+    (empty for single-device, pre-mesh, or capture-off records — the
+    skip-as-baseline convention keys off the numeric points, so an
+    ``enabled: False`` block reads the same as no block):
+    ``mesh.balance_ratio`` (higher is better, relative) and
+    ``mesh.hot_loop_float_collectives`` (the zero-collective contract —
+    judged absolutely, any growth fails)."""
+    out: dict[str, float] = {}
+    mesh = _get(rec, "telemetry.mesh")
+    if not isinstance(mesh, dict) or mesh.get("enabled") is False:
+        return out
+    ratio = (mesh.get("balance") or {}).get("ratio")
+    if isinstance(ratio, (int, float)):
+        out["mesh.balance_ratio"] = float(ratio)
+    hot = ((mesh.get("collectives") or {}).get("hot_loop") or {}).get(
+        "float_count"
+    )
+    if isinstance(hot, (int, float)):
+        out["mesh.hot_loop_float_collectives"] = float(hot)
+    return out
+
+
 def diff_series(
     records: list[tuple[str, dict]],
     threshold: float,
     quality_threshold: float = DEFAULT_QUALITY_THRESHOLD,
     slo: bool = False,
     slo_threshold: float = DEFAULT_SLO_THRESHOLD,
+    mesh: bool = False,
+    mesh_threshold: float = DEFAULT_MESH_THRESHOLD,
 ) -> tuple[list[str], bool, list[dict]]:
     """Compare the last record pairwise against every earlier one, each
     pair in the strongest normalization basis BOTH sides support (ledger
@@ -557,6 +600,107 @@ def diff_series(
                     "verdict": "regression" if bad else "ok",
                 }
             )
+    # -- mesh: balance ratio + hot-loop contract, opt-in via --mesh -------
+    if mesh:
+        new_mesh = _mesh_points(latest)
+        old_mesh: dict[str, list[tuple[str, float]]] = {}
+        any_baseline_mesh = False
+        for path, rec in earlier:
+            pts = _mesh_points(rec)
+            any_baseline_mesh |= bool(pts)
+            for name, v in pts.items():
+                old_mesh.setdefault(name, []).append((path, v))
+        if not any_baseline_mesh and not new_mesh:
+            lines.append(
+                f"  mesh: no telemetry.mesh metrics in {latest_path} or "
+                "any baseline — skipped"
+            )
+            entries.append(
+                {"metric": "mesh", "verdict": "skipped", "reason": "absent"}
+            )
+        elif any_baseline_mesh and not new_mesh:
+            # block-level capture loss: a baseline measured its per-device
+            # balance, the latest record measured nothing — same
+            # non-disarmable discipline as quality/slo capture
+            regressed = True
+            lines.append(
+                f"  mesh: baselines carry telemetry.mesh but {latest_path} "
+                "does not — mesh capture was lost  ** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": "mesh",
+                    "kind": "mesh",
+                    "verdict": "regression",
+                    "reason": "mesh_capture_lost",
+                }
+            )
+        for name in sorted(new_mesh):
+            new_v = new_mesh[name]
+            olds = old_mesh.get(name, [])
+            if not olds:
+                lines.append(
+                    f"  {name}: no comparable earlier record — skipped"
+                )
+                entries.append(
+                    {"metric": name, "verdict": "skipped",
+                     "reason": "no_baseline"}
+                )
+                continue
+            if name == "mesh.hot_loop_float_collectives":
+                # the states-sharding contract: a float collective in the
+                # hot loop is candidate/objective data crossing devices
+                # per generation — any growth over the best baseline fails,
+                # no threshold (shard_lint catches these pre-commit; this
+                # gate catches them in the committed evidence)
+                path, old_v = min(olds, key=lambda t: t[1])
+                bad = new_v > old_v
+                regressed |= bad
+                lines.append(
+                    f"  {name}: {new_v:g} vs best {old_v:g} ({path}) "
+                    "[absolute]"
+                    + ("  ** REGRESSION **" if bad else "")
+                )
+                entries.append(
+                    {
+                        "metric": name,
+                        "kind": "mesh",
+                        "basis": "absolute",
+                        "baseline": path,
+                        "old": old_v,
+                        "new": new_v,
+                        "verdict": "regression" if bad else "ok",
+                    }
+                )
+                continue
+            pairs = [
+                ((old_v - new_v) / old_v, path, old_v)
+                for path, old_v in olds
+                if old_v != 0
+            ]
+            if not pairs:
+                continue
+            rel, path, old_v = max(pairs, key=lambda t: t[0])
+            bad = rel > mesh_threshold
+            regressed |= bad
+            direction = "worse" if rel > 0 else "better"
+            lines.append(
+                f"  {name}: {new_v:.6g} vs best {old_v:.6g} ({path}) "
+                f"[mesh] -> {abs(rel) * 100:.1f}% {direction}"
+                + ("  ** REGRESSION **" if bad else "")
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "mesh",
+                    "basis": "relative",
+                    "baseline": path,
+                    "old": old_v,
+                    "new": new_v,
+                    "delta_rel": rel,
+                    "verdict": "regression" if bad else "ok",
+                }
+            )
     return lines, regressed, entries
 
 
@@ -601,6 +745,22 @@ def main(argv=None) -> int:
         default=DEFAULT_SLO_THRESHOLD,
         help="relative SLO regression that fails under --slo "
         f"(default {DEFAULT_SLO_THRESHOLD})",
+    )
+    parser.add_argument(
+        "--mesh",
+        action="store_true",
+        help="also gate the mesh metrics: per-device balance ratio "
+        "(relative drop) and hot-loop float collectives (any growth "
+        "fails — the zero-collective contract). Pre-mesh and "
+        "single-device records skip as baselines; a latest record that "
+        "LOST mesh capture fails",
+    )
+    parser.add_argument(
+        "--mesh-threshold",
+        type=float,
+        default=DEFAULT_MESH_THRESHOLD,
+        help="relative balance-ratio drop that fails under --mesh "
+        f"(default {DEFAULT_MESH_THRESHOLD})",
     )
     parser.add_argument(
         "--json",
@@ -651,6 +811,8 @@ def main(argv=None) -> int:
         args.quality_threshold,
         slo=args.slo,
         slo_threshold=args.slo_threshold,
+        mesh=args.mesh,
+        mesh_threshold=args.mesh_threshold,
     )
     print("\n".join(lines))
     if regressed:
@@ -669,6 +831,8 @@ def main(argv=None) -> int:
                     "quality_threshold": args.quality_threshold,
                     "slo": args.slo,
                     "slo_threshold": args.slo_threshold,
+                    "mesh": args.mesh,
+                    "mesh_threshold": args.mesh_threshold,
                     "regressed": regressed,
                     "metrics": entries,
                 }

@@ -1,0 +1,570 @@
+#!/usr/bin/env python
+"""Sharding/transfer lint: machine-check the states-sharding contract.
+
+``attacks/sharding.py`` promises that the attack hot loops shard the
+states axis over the mesh with no data-plane communication. Until now
+that contract lived in prose; this tool compiles the real attack programs
+— PGD, AutoPGD, and the MoEvA init/segment/success-gate — for each
+lintable domain on an emulated 8-device CPU mesh (the
+``xla_force_host_platform_device_count`` recipe tests/conftest.py uses)
+and fails on:
+
+- **float collectives in the hot loop** — an all-gather/all-reduce/
+  reduce-scatter/collective-permute moving floating-point payload in the
+  ``pgd_attack``/``moeva_segment`` executables means candidate or
+  objective DATA crosses devices per iteration/generation. (The SPMD
+  partitioner legitimately inserts small u32 RNG-key, pred
+  loop-consensus, and s32 index collectives even into embarrassingly
+  parallel programs — measured ~4.5 KB/segment at lint shapes; those are
+  control-plane, tolerated but byte-bounded by the next rule.)
+- **collective bytes over budget** — total estimated collective bytes in
+  a hot-loop executable past ``--collective-bytes-limit`` (default
+  1 MiB/dispatch: ~200x the measured control-plane traffic, orders of
+  magnitude under a population-sized gather at production shapes).
+- **implicit host<->device transfers at dispatch** — the run executes
+  with ``jax.transfer_guard("disallow")`` scoped around every compiled
+  dispatch (the ``observability.ledger.set_dispatch_transfer_guard``
+  seam), so an argument that is not already resident on its devices
+  raises instead of silently serialising the hot path through the host.
+- **unintended full replication** — a program whose states-sharded
+  inputs compiled fine but whose largest output came back fully
+  replicated (or a multi-device attack program with NOTHING sharded at
+  all) multiplies memory and work by the mesh size.
+
+    python tools/shard_lint.py --check        # lint committed domains (tier-1)
+    python tools/shard_lint.py --selftest     # verify the lint trips on
+                                              # injected violations
+    python tools/shard_lint.py --check --json # + machine-readable line
+
+Domains: the code-derived synthetic LCLD schema always (dataset-free);
+the reference lcld/botnet schemas when /root/reference exists (skipped,
+not failed, otherwise — same convention as tools/oracle_check.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD_MARKER = "_MOEVA2_SHARD_LINT_CHILD"
+
+#: default per-dispatch collective-bytes budget for hot-loop executables.
+DEFAULT_COLLECTIVE_BYTES_LIMIT = 1 << 20
+
+#: producers whose executables are linted as the hot loop — the single
+#: source is observability.mesh, so the lint and the telemetry.mesh
+#: hot-loop classification (bench_diff --mesh's gate) cannot drift.
+from moeva2_ijcai22_replication_tpu.observability.mesh import (  # noqa: E402
+    HOT_LOOP_PRODUCERS as HOT,
+)
+
+#: every attack producer linted for replication (gate/init included — they
+#: are per-state programs too, just not per-generation).
+ATTACK_PRODUCERS = HOT + ("moeva_init", "moeva_success")
+
+
+def _ensure_devices(n_devices: int, argv_rest: list[str]) -> bool:
+    """True when this process already has the virtual mesh; otherwise
+    re-exec into a child with the forced device count (parent env never
+    mutated — the tests/conftest.py / __graft_entry__ recipe)."""
+    import jax
+
+    if os.environ.get(_CHILD_MARKER):
+        jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) >= n_devices:
+        return True
+    if os.environ.get(_CHILD_MARKER):
+        raise RuntimeError(
+            f"virtual-device bootstrap failed: forced {n_devices} devices "
+            f"but jax.devices() = {len(jax.devices())}"
+        )
+    import subprocess
+
+    env = dict(os.environ)
+    env[_CHILD_MARKER] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    kept = [
+        tok
+        for tok in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in tok
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        kept + [f"--xla_force_host_platform_device_count={n_devices}"]
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *argv_rest],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    sys.exit(proc.returncode)
+
+
+# ---------------------------------------------------------------------------
+# rules (pure functions over ledger entries — unit-testable without compiles)
+# ---------------------------------------------------------------------------
+def classify_dispatch_error(exc: BaseException) -> str:
+    """Rule name for an exception raised under the armed transfer guard:
+    only guard trips ("Disallowed ... transfer") are ``host_transfer`` —
+    anything else is ``engine_error``, still a lint failure (the attack
+    programs must compile and run on the mesh) but labeled honestly so an
+    unrelated engine regression does not read as a broken sharding
+    contract."""
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if "transfer" in text and ("disallow" in text or "guard" in text):
+        return "host_transfer"
+    return "engine_error"
+
+
+
+def lint_entry(
+    entry,
+    *,
+    hot=HOT,
+    collective_bytes_limit: float = DEFAULT_COLLECTIVE_BYTES_LIMIT,
+    expect_sharded: bool = True,
+) -> list[dict]:
+    """Violations of one ledger entry (attributes of
+    ``observability.ledger.LedgerEntry`` or an object with the same
+    ``producer``/``devices``/``partitions``/``sharding``/``collectives``
+    shape). Single-device entries lint clean by construction."""
+    out: list[dict] = []
+    if getattr(entry, "devices", 1) <= 1:
+        return out
+    producer = getattr(entry, "producer", "?")
+    key = getattr(entry, "key", "?")
+    col = getattr(entry, "collectives", None) or {}
+    if producer in hot:
+        if col.get("float_count"):
+            out.append(
+                {
+                    "rule": "hot_loop_float_collective",
+                    "producer": producer,
+                    "key": key,
+                    "detail": (
+                        f"{col['float_count']} collective(s) moving "
+                        f"{col.get('float_bytes', 0):.0f} bytes of "
+                        "floating-point payload — candidate/objective data "
+                        "crosses devices in the hot loop"
+                    ),
+                }
+            )
+        if col.get("bytes", 0.0) > collective_bytes_limit:
+            out.append(
+                {
+                    "rule": "hot_loop_collective_bytes",
+                    "producer": producer,
+                    "key": key,
+                    "detail": (
+                        f"collectives move {col.get('bytes', 0.0):.0f} "
+                        f"bytes/dispatch > limit {collective_bytes_limit:.0f}"
+                    ),
+                }
+            )
+    sharding = getattr(entry, "sharding", None) or {}
+    if expect_sharded and producer in ATTACK_PRODUCERS:
+        if getattr(entry, "partitions", 1) <= 1:
+            out.append(
+                {
+                    "rule": "fully_replicated_program",
+                    "producer": producer,
+                    "key": key,
+                    "detail": (
+                        f"compiled on {entry.devices} devices with NOTHING "
+                        "partitioned — the states-sharded placement was "
+                        "requested but every array is fully replicated"
+                    ),
+                }
+            )
+        else:
+            in_sum = sharding.get("in") or {}
+            out_sum = sharding.get("out") or {}
+            largest_out = out_sum.get("largest") if out_sum else None
+            largest_sharded_in = max(
+                (
+                    r["bytes"]
+                    for r in [in_sum.get("largest") or {}]
+                    if r.get("sharded")
+                ),
+                default=in_sum.get("sharded_bytes", 0),
+            )
+            # the big outputs of a states-sharded program must come back
+            # states-sharded: a replicated output as large as the sharded
+            # inputs means XLA (or a sharding constraint) materialised the
+            # full batch on every device
+            if (
+                largest_out is not None
+                and not largest_out.get("sharded")
+                and largest_out.get("bytes", 0)
+                >= max(4096, 0.5 * largest_sharded_in)
+            ):
+                out.append(
+                    {
+                        "rule": "replicated_large_output",
+                        "producer": producer,
+                        "key": key,
+                        "detail": (
+                            f"largest output ({largest_out.get('bytes', 0)} "
+                            f"bytes, spec {largest_out.get('spec')}) is "
+                            "fully replicated while states-sharded inputs "
+                            "were requested"
+                        ),
+                    }
+                )
+    return out
+
+
+def lint_entries(entries, **kw) -> list[dict]:
+    out = []
+    for e in entries:
+        out.extend(lint_entry(e, **kw))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# domain lint: compile + dispatch the real attack programs
+# ---------------------------------------------------------------------------
+def _synth_problem(tmp_dir: str):
+    import numpy as np
+
+    from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_lcld,
+        synth_lcld_schema,
+    )
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+    from moeva2_ijcai22_replication_tpu.models.mlp import (
+        init_params,
+        lcld_mlp,
+    )
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    paths = synth_lcld_schema(tmp_dir)
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(16, cons.schema, seed=3)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+    return cons, x, sur, fit_minmax(x.min(0), x.max(0))
+
+
+def _reference_problem(domain: str):
+    import numpy as np
+
+    from moeva2_ijcai22_replication_tpu.domains import get_constraints_class
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    base = f"/root/reference/data/{domain}"
+    features = f"{base}/features.csv"
+    constraints = f"{base}/constraints.csv"
+    if not os.path.exists(features):
+        return None
+    cons = get_constraints_class(domain)(features, constraints)
+    cand = f"{base}/x_candidates_common.npy"
+    if os.path.exists(cand):
+        x = np.load(cand)[:16].astype(np.float64)
+    else:
+        return None  # no committed candidate set for this schema
+    model = lcld_mlp(n_features=cons.schema.n_features) if domain == "lcld" else None
+    if model is None:
+        from moeva2_ijcai22_replication_tpu.models.mlp import botnet_mlp
+
+        model = botnet_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+    return cons, x, sur, fit_minmax(x.min(0), x.max(0))
+
+
+def lint_domain(
+    name: str,
+    problem,
+    mesh,
+    *,
+    collective_bytes_limit: float = DEFAULT_COLLECTIVE_BYTES_LIMIT,
+) -> list[dict]:
+    """Compile + dispatch every attack program family for one domain on
+    ``mesh`` with the transfer guard armed; returns violations."""
+    import numpy as np
+
+    from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+    from moeva2_ijcai22_replication_tpu.attacks.pgd import (
+        AutoPGD,
+        ConstrainedPGD,
+    )
+    from moeva2_ijcai22_replication_tpu.observability.ledger import (
+        get_ledger,
+        set_dispatch_transfer_guard,
+    )
+
+    cons, x, sur, scaler = problem
+    ledger = get_ledger()
+    before = {e.key for e in ledger.entries()}
+    violations: list[dict] = []
+    prev_guard = set_dispatch_transfer_guard("disallow")
+    try:
+        # MoEvA: tiny budget; quality_every forces the success-gate program
+        # to compile+dispatch so all three executables get linted
+        moeva = Moeva2(
+            classifier=sur, constraints=cons, ml_scaler=scaler,
+            norm=2, n_gen=5, n_pop=8, n_offsprings=4, seed=0,
+            archive_size=2, record_quality=True, quality_every=2,
+            mesh=mesh,
+        )
+        try:
+            moeva.generate(x, minimize_class=1)
+        except Exception as e:
+            violations.append(
+                {
+                    "rule": classify_dispatch_error(e),
+                    "producer": "moeva",
+                    "domain": name,
+                    "detail": f"{type(e).__name__}: {e}",
+                }
+            )
+        xs = np.asarray(scaler.transform(x))
+        y = np.ones(len(xs), dtype=np.int64)
+        for label, cls in (("pgd", ConstrainedPGD), ("autopgd", AutoPGD)):
+            attack = cls(
+                classifier=sur, constraints=cons, scaler=scaler,
+                eps=0.2, eps_step=0.05, max_iter=4,
+                loss_evaluation="constraints+flip", mesh=mesh,
+            )
+            try:
+                attack.generate(xs, y)
+            except Exception as e:
+                violations.append(
+                    {
+                        "rule": classify_dispatch_error(e),
+                        "producer": label,
+                        "domain": name,
+                        "detail": f"{type(e).__name__}: {e}",
+                    }
+                )
+    finally:
+        set_dispatch_transfer_guard(prev_guard)
+    new_entries = [e for e in ledger.entries() if e.key not in before]
+    for v in lint_entries(
+        new_entries, collective_bytes_limit=collective_bytes_limit
+    ):
+        violations.append(dict(v, domain=name))
+    return violations
+
+
+def run_lint(
+    n_devices: int = 8,
+    *,
+    collective_bytes_limit: float = DEFAULT_COLLECTIVE_BYTES_LIMIT,
+) -> tuple[list[dict], list[str], list[str]]:
+    """Lint every available domain; returns (violations, linted, skipped)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("states",))
+    violations: list[dict] = []
+    linted, skipped = [], []
+    with tempfile.TemporaryDirectory() as tmp:
+        problems = {"lcld_synth": _synth_problem(tmp)}
+        for domain in ("botnet",):
+            p = _reference_problem(domain)
+            if p is None:
+                skipped.append(domain)
+            else:
+                problems[domain] = p
+        for name, problem in problems.items():
+            violations.extend(
+                lint_domain(
+                    name,
+                    problem,
+                    mesh,
+                    collective_bytes_limit=collective_bytes_limit,
+                )
+            )
+            linted.append(name)
+    return violations, linted, skipped
+
+
+# ---------------------------------------------------------------------------
+# selftest: the lint must FAIL on injected violations
+# ---------------------------------------------------------------------------
+def injected_collective_violations(mesh) -> list[dict]:
+    """Compile a hot-loop-named program with an explicit full all-gather
+    of a float population tensor (a replicated sharding constraint forces
+    one) — the lint must flag it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from moeva2_ijcai22_replication_tpu.observability.ledger import (
+        CostLedger,
+        LedgeredJit,
+    )
+
+    led = CostLedger()
+    x = jax.device_put(
+        jnp.ones((16, 64), jnp.float32), NamedSharding(mesh, P("states"))
+    )
+
+    def bad(x):
+        # force the full population onto every device: an all-gather in
+        # the compiled HLO, exactly what a states-mixing bug looks like
+        gathered = jax.lax.with_sharding_constraint(x * 2.0, NamedSharding(mesh, P()))
+        return gathered - gathered.mean()
+
+    lj = LedgeredJit(jax.jit(bad), producer="moeva_segment", ledger=led)
+    lj(x)
+    return lint_entries(led.entries())
+
+
+def injected_transfer_violation(mesh) -> list[dict]:
+    """Dispatch a compiled multi-device program with a host numpy argument
+    under the armed transfer guard — the implicit host->device transfer
+    at dispatch must raise, which the lint reports as a violation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from moeva2_ijcai22_replication_tpu.observability.ledger import (
+        CostLedger,
+        LedgeredJit,
+        set_dispatch_transfer_guard,
+    )
+
+    led = CostLedger()
+    x = jax.device_put(
+        jnp.ones((16, 8), jnp.float32), NamedSharding(mesh, P("states"))
+    )
+    lj = LedgeredJit(jax.jit(lambda x: x + 1), producer="pgd_attack", ledger=led)
+    lj(x)  # compile + clean dispatch with resident args
+    prev = set_dispatch_transfer_guard("disallow")
+    try:
+        lj(np.ones((16, 8), np.float32))  # host arg: implicit transfer
+    except Exception as e:
+        return [
+            {
+                "rule": classify_dispatch_error(e),
+                "producer": "pgd_attack",
+                "detail": f"{type(e).__name__}: {e}",
+            }
+        ]
+    finally:
+        set_dispatch_transfer_guard(prev)
+    return []
+
+
+def run_selftest(n_devices: int = 8) -> dict:
+    """Verify the lint trips on injected violations AND that a clean
+    sharded program lints clean. Returns per-check booleans."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from moeva2_ijcai22_replication_tpu.observability.ledger import (
+        CostLedger,
+        LedgeredJit,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("states",))
+    col = injected_collective_violations(mesh)
+    tra = injected_transfer_violation(mesh)
+    led = CostLedger()
+    x = jax.device_put(
+        jnp.ones((16, 8), jnp.float32), NamedSharding(mesh, P("states"))
+    )
+    clean_lj = LedgeredJit(
+        jax.jit(lambda x: x * 2 + 1), producer="pgd_attack", ledger=led
+    )
+    clean_lj(x)
+    clean = lint_entries(led.entries())
+    return {
+        "collective_tripped": any(
+            v["rule"].startswith("hot_loop") or v["rule"] == "replicated_large_output"
+            for v in col
+        ),
+        "transfer_tripped": any(v["rule"] == "host_transfer" for v in tra),
+        "clean_passes": not clean,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="lint the committed domains (tier-1 repo-check mode)",
+    )
+    parser.add_argument(
+        "--selftest",
+        action="store_true",
+        help="verify the lint trips on injected violations",
+    )
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument(
+        "--collective-bytes-limit",
+        type=float,
+        default=DEFAULT_COLLECTIVE_BYTES_LIMIT,
+        help="hot-loop collective bytes budget per dispatch "
+        f"(default {DEFAULT_COLLECTIVE_BYTES_LIMIT})",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable last line"
+    )
+    args = parser.parse_args(argv)
+    if not args.check and not args.selftest:
+        parser.error("pass --check and/or --selftest")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _ensure_devices(args.devices, list(argv) if argv is not None else sys.argv[1:])
+
+    rc = 0
+    result: dict = {"devices": args.devices}
+    if args.selftest:
+        st = run_selftest(args.devices)
+        result["selftest"] = st
+        for check, ok in st.items():
+            print(f"shard_lint selftest: {check}: {'ok' if ok else 'FAILED'}")
+        if not all(st.values()):
+            rc = 1
+    if args.check:
+        violations, linted, skipped = run_lint(
+            args.devices,
+            collective_bytes_limit=args.collective_bytes_limit,
+        )
+        result.update(
+            {"violations": violations, "linted": linted, "skipped": skipped}
+        )
+        print(
+            f"shard_lint: linted {linted} on a {args.devices}-device mesh"
+            + (f", skipped {skipped} (no reference data)" if skipped else "")
+        )
+        for v in violations:
+            print(
+                f"  VIOLATION [{v['rule']}] {v.get('domain', '?')}/"
+                f"{v.get('producer', '?')}: {v.get('detail', '')}"
+            )
+        if violations:
+            rc = 1
+            print("shard_lint: FAILED — the states-sharding contract is broken")
+        else:
+            print(
+                "shard_lint: ok — zero hot-loop data collectives, no "
+                "implicit transfers, no unintended replication"
+            )
+    if args.json:
+        print(json.dumps(dict(result, ok=rc == 0)))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,22 +91,22 @@ def measure_ref_pergen() -> float:
         xb = tf.constant(np.random.rand(N_OFF, 47).astype(np.float32))
         for _ in range(3):
             f(xb)
-        t0 = time.time()
+        t0 = time.perf_counter()
         reps = 30
         for _ in range(reps):
             f(xb)
-        t_fwd = (time.time() - t0) / reps
+        t_fwd = (time.perf_counter() - t0) / reps
     except Exception as e:  # TF unavailable on bench host
         log(f"[bench] TF baseline measurement failed ({e}); using fallback")
         return FALLBACK_REF_PERGEN_S
 
     xc = np.random.rand(N_OFF, 47) * 100 + 1
     np_lcld_constraints(xc)
-    t0 = time.time()
+    t0 = time.perf_counter()
     reps = 100
     for _ in range(reps):
         np_lcld_constraints(xc)
-    t_cons = (time.time() - t0) / reps
+    t_cons = (time.perf_counter() - t0) / reps
     log(f"[bench] ref CPU per-gen/state: fwd {t_fwd*1e3:.3f} ms + cons {t_cons*1e3:.3f} ms")
     return t_fwd + t_cons
 
@@ -138,7 +138,7 @@ def measure_grid_wallclock() -> dict | None:
         try:
             for name in ("config", "models", "data", ".jax_cache"):
                 os.symlink(os.path.join(repo, name), os.path.join(td, name))
-            t0 = time.time()
+            t0 = time.perf_counter()
             try:
                 r = subprocess.run(
                     [
@@ -159,7 +159,7 @@ def measure_grid_wallclock() -> dict | None:
                 log(f"[bench] grid {label}: timed out; skipping grid metric")
                 out[label + "_rc"] = "timeout"
                 continue
-            dt = time.time() - t0
+            dt = time.perf_counter() - t0
             n_metrics = 0
             report = None
             for root, _, fs in os.walk(os.path.join(td, "out")):
@@ -243,12 +243,12 @@ def run_real_botnet() -> dict | None:
             record_quality=True,
             quality_every=int(os.environ.get("BENCH_QUALITY_EVERY", 100)),
         )
-        t0 = time.time()
+        t0 = time.perf_counter()
         res = moeva.generate(x, minimize_class=1)
-        cold = time.time() - t0
-        t0 = time.time()
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
         res = moeva.generate(x, minimize_class=1)
-        steady = time.time() - t0
+        steady = time.perf_counter() - t0
         calc = ObjectiveCalculator(
             classifier=sur, constraints=cons,
             thresholds={"f1": 0.5, "f2": 4.0},
@@ -266,6 +266,7 @@ def run_real_botnet() -> dict | None:
             "n_gen": n_gen,
             "steady_s": round(steady, 2),
             "cold_s": round(cold, 2),
+            "cold_steady_ratio": round(cold / steady, 3) if steady else None,
             "o_rates_eps4": rates,
             # engine-judged convergence curve + interior-point summary —
             # the saturation-proof record: a survival-semantics regression
@@ -334,8 +335,8 @@ def run_early_exit_bench() -> dict | None:
         x = pool[np.argsort(np.abs(p1 - threshold))[:s]]
 
         from moeva2_ijcai22_replication_tpu.observability import (
-            Trace, TraceRecorder, get_ledger, quality_block, telemetry_block,
-            validate_record,
+            Trace, TraceRecorder, get_gap_tracker, get_ledger, quality_block,
+            telemetry_block, validate_record,
         )
 
         moeva = Moeva2(
@@ -353,14 +354,15 @@ def run_early_exit_bench() -> dict | None:
         # cost window: this record reports the A/B's own executables, not
         # whatever the rest of the bench invocation compiled
         ledger_mark = get_ledger().mark()
+        gaps_mark = get_gap_tracker().mark()
 
         def timed(check_every):
             moeva.early_stop_check_every = check_every
             best, res = None, None
             for _ in range(2):  # min-of-2: first call may include compiles
-                t0 = time.time()
+                t0 = time.perf_counter()
                 res = moeva.generate(x, minimize_class=1)
-                dt = time.time() - t0
+                dt = time.perf_counter() - t0
                 best = dt if best is None else min(best, dt)
             return best, res
 
@@ -408,6 +410,7 @@ def run_early_exit_bench() -> dict | None:
                 recorder=recorder,
                 trace=moeva.trace,
                 ledger_since=ledger_mark,
+                gaps_since=gaps_mark,
                 # the early-exit run's quality curve (gate-cadence samples)
                 quality=quality_block(early.quality),
             ),
@@ -528,7 +531,16 @@ def run_serving_bench() -> dict | None:
         # pay the per-bucket-size compiles outside the measured levels: one
         # warmup request per menu size (serving steady state is the metric;
         # the compile count still lands in the record's counters)
-        t0 = time.time()
+        from moeva2_ijcai22_replication_tpu.observability import get_coldstart
+
+        cs = get_coldstart()
+
+        def _compile_phase_s():
+            ph = cs.cold_block().get("phases") or {}
+            return ph.get("trace_lower", 0.0) + ph.get("xla_compile", 0.0)
+
+        compile0 = _compile_phase_s()
+        t0 = time.perf_counter()
         for b in service.menu.sizes:
             service.attack(
                 AttackRequest(
@@ -536,7 +548,14 @@ def run_serving_bench() -> dict | None:
                 ),
                 timeout=300.0,
             )
-        warmup_s = time.time() - t0
+        warmup_s = time.perf_counter() - t0
+        # the explicit warmup loop IS the device_warmup phase of this
+        # process's cold path — minus the compile seconds it contained,
+        # which note_compile already booked under trace_lower/xla_compile
+        # (the phases must decompose the cold wall, not double-count it)
+        get_coldstart().record_phase(
+            "device_warmup", max(warmup_s - (_compile_phase_s() - compile0), 0.0)
+        )
 
         record = offered_load_sweep(service, make_request, loads, n_requests)
         record["warmup_s"] = round(warmup_s, 2)
@@ -651,26 +670,36 @@ def main():
     # must not leak their executables into the headline's flops_total,
     # which bench_diff uses as the steady_s work normalizer
     headline_mark = get_ledger().mark()
-    from moeva2_ijcai22_replication_tpu.observability import get_mesh_capture
+    from moeva2_ijcai22_replication_tpu.observability import (
+        get_coldstart, get_gap_tracker, get_mesh_capture,
+    )
 
     mesh_mark = get_mesh_capture().mark()
+    gaps_mark = get_gap_tracker().mark()
 
-    t0 = time.time()
+    # cold/steady on time.perf_counter (monotonic): an NTP step during the
+    # minutes-long cold run must not corrupt the cold decomposition the
+    # watchdog gates on (same fix PR 4 applied to PhaseTimer)
+    t0 = time.perf_counter()
     res = moeva.generate(x, minimize_class=1)
-    cold_s = time.time() - t0  # includes jit compile / cache load
+    cold_s = time.perf_counter() - t0  # includes jit compile / cache load
     # steady state: best of two compiled runs — the tunnelled device shows
     # ~±10% run-to-run jitter, and the minimum is the standard estimator of
     # a program's intrinsic cost under external interference
     steady_runs = []
     for _ in range(2):
-        t0 = time.time()
+        t0 = time.perf_counter()
         res = moeva.generate(x, minimize_class=1)
-        steady_runs.append(time.time() - t0)
+        steady_runs.append(time.perf_counter() - t0)
     ours_s = min(steady_runs)
     headline_telemetry = telemetry_block(
         recorder=bench_recorder,
         trace=moeva.trace,
         ledger_since=headline_mark,
+        # dispatch-gap window: the headline's overlap ratio (device-busy /
+        # compile-free wall) + its top attributed gap stages — the number
+        # that says which host stage to double-buffer next
+        gaps_since=gaps_mark,
         # the headline run's engine-judged convergence curve + interior
         # summary — what bench_diff diffs across the committed series
         quality=quality_block(res.quality),
@@ -761,6 +790,15 @@ def main():
         "steady_s": round(ours_s, 2),
         "cold_s": round(cold_s, 2),
         "speedup_cold": round(ref_s / cold_s, 2),
+        # the ratio the --overlap watchdog gates (ROADMAP item 2 exit
+        # criterion: cold <= 1.2x steady) next to its decomposition
+        "cold_steady_ratio": round(cold_s / ours_s, 3),
+        # structured cold breakdown (observability.coldstart): import /
+        # artifact-build / trace-lower / XLA-compile phase seconds,
+        # per-executable persistent-cache hit/miss classification against
+        # the .jax_cache dir (the "N entries rebuilt per process" number),
+        # and time-to-first-dispatch — where the cold seconds GO
+        "cold": get_coldstart().cold_block(),
         # shared record schema (observability.records)
         "execution": {
             "max_states_per_call": moeva.effective_states_chunk(),

@@ -32,7 +32,8 @@ from ...core.constraints import ConstraintSet
 from ...core.norms import is_l2, lp_distance, validate_norm
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
-from ...observability import all_device_memory_stats, device_memory_stats
+from ...observability import all_device_memory_stats, device_memory_stats, maybe_span
+from ...observability.gaps import emit_window_trace, get_gap_tracker
 from ...observability.ledger import LedgeredJit, get_ledger
 from ...observability.mesh import get_mesh_capture
 from ...observability.quality import merge_chunk_quality, sample_from_per_state
@@ -324,9 +325,12 @@ class Moeva2:
         }
 
     def _on_ledger_dispatch(self, entry, compile_s: float) -> None:
-        self._dispatch_log.append((entry, compile_s))
+        # the enqueue instant rides along so the dispatch-gap tracker can
+        # place this dispatch on the process device timeline — a clock
+        # read the dispatch path makes anyway, never a device sync
+        self._dispatch_log.append((entry, compile_s, time.perf_counter()))
 
-    def _attribute_run(self, elapsed: float) -> None:
+    def _attribute_run(self, t0: float, elapsed: float) -> None:
         """Split one ``generate``'s measured wall-clock (compile excluded)
         across the executables it dispatched, weighted by the cost model
         (per-dispatch FLOPs; uniform when no backend cost model) — the
@@ -335,7 +339,7 @@ class Moeva2:
         approximate in DESIGN § cost ledger)."""
         log, self._dispatch_log = self._dispatch_log, []
         balance_log, self._balance_log = self._balance_log, []
-        entries = [e for e, _ in log if e is not None]
+        entries = [e for e, _, _ in log if e is not None]
         self.last_run_executables = list(
             dict.fromkeys(e.key for e in entries)
         )
@@ -343,7 +347,7 @@ class Moeva2:
         for e in entries:
             counts[e.key] = counts.get(e.key, 0) + 1
         self.last_run_dispatch_counts = counts
-        run_total = max(elapsed - sum(c for _, c in log), 0.0)
+        run_total = max(elapsed - sum(c for _, c, _ in log), 0.0)
         # per-device balance: split the run seconds across the logged
         # segment windows by generation count, attributing each window's
         # seconds to devices in proportion to their live-row share — pads
@@ -359,6 +363,36 @@ class Moeva2:
                 capture.record_balance(
                     rows, run_total * gens / total_gens
                 )
+        # dispatch-gap ledger: place this run's dispatches on the process
+        # device timeline (recorded at this same sync point, zero new
+        # syncs). Independent of the cost-ledger knob — with the ledger
+        # off entries are None and the run splits uniformly.
+        if log:
+            weights_all = [
+                (e.flops if e is not None and e.flops else None)
+                for e, _, _ in log
+            ]
+            if any(w is None for w in weights_all):
+                weights_all = [1.0] * len(log)
+            wsum = sum(weights_all) or 1.0
+            window = get_gap_tracker().record_window(
+                producer="moeva",
+                engine=getattr(self, "cache_key", None),
+                start=t0,
+                end=t0 + elapsed,
+                dispatches=[
+                    (
+                        ts,
+                        run_total * w / wsum,
+                        c,
+                        e.key if e is not None else None,
+                    )
+                    for (e, c, ts), w in zip(log, weights_all)
+                ],
+            )
+            # Perfetto: device-busy counter sample + named gap slices at
+            # their true timeline instants (no-op when the trace is off)
+            emit_window_trace(self.trace, window)
         if not entries:
             return
         weights = [e.flops for e in entries]
@@ -607,7 +641,7 @@ class Moeva2:
         finally:
             # roofline attribution at the one point where every dispatched
             # segment has been fetched (the result decode above synced)
-            self._attribute_run(time.perf_counter() - t0)
+            self._attribute_run(t0, time.perf_counter() - t0)
 
     def _generate_chunked(self, x, minimize_class, chunk) -> MoevaResult:
         """Sequential chunks of one compiled program; the tail chunk is
@@ -1058,7 +1092,8 @@ class Moeva2:
                 nonlocal pending
                 if pending is None:
                     return
-                arr = np.asarray(jax.device_get(pending))
+                with maybe_span(self.trace, "fetch", what="history"):
+                    arr = np.asarray(jax.device_get(pending))
                 if cp is not None:
                     cp.add_hist_chunk(len(hist_chunks), arr)
                 hist_chunks.append(arr)
@@ -1075,7 +1110,8 @@ class Moeva2:
                     # fetch the per-state stats leaf and scatter it home:
                     # pads (row_live False) never overwrite a real row,
                     # parked rows keep the stats frozen at park time
-                    stats = np.asarray(jax.device_get(stats_dev))
+                    with maybe_span(self.trace, "gate_fetch", what="quality"):
+                        stats = np.asarray(jax.device_get(stats_dev))
                     qual_latest[row_src[row_live]] = stats[row_live]
                     qual_samples.append(
                         sample_from_per_state(done, qual_latest)
@@ -1090,7 +1126,8 @@ class Moeva2:
                         success_frac=None if sf is None else round(sf, 4),
                     )
                 if check:
-                    succ = np.asarray(jax.device_get(succ_dev))
+                    with maybe_span(self.trace, "gate_fetch", what="mask"):
+                        succ = np.asarray(jax.device_get(succ_dev))
                     solved = row_live & succ
                     n_parked = int(solved.sum())
                     if n_parked:
@@ -1110,12 +1147,15 @@ class Moeva2:
                                     (s, cols, 3), dtype=np.dtype(self.dtype)
                                 ),
                             }
-                        px, pf = jax.device_get(
-                            self._final_columns(carry, idx)
-                        )
-                        parked["mask"][row_src[idx]] = True
-                        parked["x"][row_src[idx]] = px
-                        parked["f"][row_src[idx]] = pf
+                        with maybe_span(
+                            self.trace, "parked_merge", rows=int(n_parked)
+                        ):
+                            px, pf = jax.device_get(
+                                self._final_columns(carry, idx)
+                            )
+                            parked["mask"][row_src[idx]] = True
+                            parked["x"][row_src[idx]] = px
+                            parked["f"][row_src[idx]] = pf
                         row_live = row_live & ~succ
                     n_active = int(row_live.sum())
                     if n_active == 0:
@@ -1229,27 +1269,30 @@ class Moeva2:
 
     def _finalize_one(self, run: _InFlightRun) -> MoevaResult:
         if run.pending is not None:
-            run.hist_chunks.append(np.asarray(jax.device_get(run.pending)))
+            with maybe_span(self.trace, "fetch", what="history"):
+                run.hist_chunks.append(np.asarray(jax.device_get(run.pending)))
             run.pending = None
         pop_x, pop_f, arch_x, arch_f, _, _ = run.carry
         if self.archive_size:
             # archive members join the returned populations (extra columns)
             pop_x = jnp.concatenate([pop_x, arch_x], axis=1)
             pop_f = jnp.concatenate([pop_f, arch_f], axis=1)
-        pop_x, pop_f = jax.device_get((pop_x, pop_f))
+        with maybe_span(self.trace, "fetch", what="populations"):
+            pop_x, pop_f = jax.device_get((pop_x, pop_f))
         s = run.x.shape[0]
         if run.parked is not None or len(run.row_src) != s:
             # merge: parked rows keep their frozen populations; surviving
             # rows land back at their original indices; pad rows drop
-            out_x = np.zeros((s,) + pop_x.shape[1:], pop_x.dtype)
-            out_f = np.zeros((s,) + pop_f.shape[1:], pop_f.dtype)
-            if run.parked is not None:
-                m = run.parked["mask"]
-                out_x[m] = run.parked["x"][m]
-                out_f[m] = run.parked["f"][m]
-            out_x[run.row_src[run.row_live]] = pop_x[run.row_live]
-            out_f[run.row_src[run.row_live]] = pop_f[run.row_live]
-            pop_x, pop_f = out_x, out_f
+            with maybe_span(self.trace, "parked_merge", rows=int(s)):
+                out_x = np.zeros((s,) + pop_x.shape[1:], pop_x.dtype)
+                out_f = np.zeros((s,) + pop_f.shape[1:], pop_f.dtype)
+                if run.parked is not None:
+                    m = run.parked["mask"]
+                    out_x[m] = run.parked["x"][m]
+                    out_f[m] = run.parked["f"][m]
+                out_x[run.row_src[run.row_live]] = pop_x[run.row_live]
+                out_f[run.row_src[run.row_live]] = pop_f[run.row_live]
+                pop_x, pop_f = out_x, out_f
         elapsed = time.time() - run.t0
         if run.cp is not None:
             run.cp.clear()  # run finished: recovery artifacts no longer needed
@@ -1273,7 +1316,7 @@ class Moeva2:
             decode_dev = jax.devices("cpu")[0]
         except RuntimeError:
             decode_dev = None
-        with jax.default_device(decode_dev):
+        with maybe_span(self.trace, "decode"), jax.default_device(decode_dev):
             x_ml = np.asarray(
                 codec_lib.genetic_to_ml(
                     self.codec,

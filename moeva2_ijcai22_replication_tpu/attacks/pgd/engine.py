@@ -34,6 +34,7 @@ from ...core.constraints import ConstraintSet
 from ...core.norms import condition_grad, is_inf, project_ball
 from ...models.io import Surrogate
 from ...models.scalers import MinMaxParams
+from ...observability.gaps import emit_window_trace, get_gap_tracker
 from ...observability.ledger import LedgeredJit, get_ledger
 
 
@@ -424,6 +425,14 @@ class ConstrainedPGD:
             args = (params, x_dev, y_dev, key, eps_d, step_d)
         t0 = time.perf_counter()
         out, hist, succ_curve = self._jit_attack(*args, mi)
+        # device-run end, read at the sync point the first device_get
+        # below would block on anyway (no new sync — just a clock read at
+        # the wait/fetch split): the gap ledger needs device-busy separate
+        # from the host-side fetch/decode tail, which the ledger's own
+        # run attribution deliberately folds in (roofline semantics
+        # unchanged below)
+        jax.block_until_ready(out)
+        t_run_end = time.perf_counter()
         # (N, max_iter, C) — runners add the reference's unit axis on save
         # (01_pgd_united.py:196-199).
         self.loss_history = (
@@ -448,11 +457,32 @@ class ConstrainedPGD:
         self.last_run_dispatch_counts = (
             {entry.key: 1} if entry is not None else {}
         )
-        run_s = (
-            time.perf_counter() - t0 - self._jit_attack.last_call_compile_s
-        )
+        t_end = time.perf_counter()
+        compile_s = self._jit_attack.last_call_compile_s
+        run_s = t_end - t0 - compile_s
         if entry is not None:
             get_ledger().add_run_seconds(entry.key, run_s)
+        # dispatch-gap ledger: one window per generate. Device busy runs
+        # from the post-compile enqueue to the block_until_ready instant;
+        # the fetch/bookkeeping tail after it is the window's gap — the
+        # host-side idle the overlap ratio exists to surface (the
+        # ledger's run_s above keeps the fetch folded in, its documented
+        # roofline semantics).
+        window = get_gap_tracker().record_window(
+            producer="pgd",
+            engine=getattr(self, "cache_key", None),
+            start=t0,
+            end=t_end,
+            dispatches=[
+                (
+                    t0 + compile_s,
+                    max(t_run_end - t0 - compile_s, 0.0),
+                    compile_s,
+                    entry.key if entry is not None else None,
+                )
+            ],
+        )
+        emit_window_trace(getattr(self, "trace", None), window)
         if self.mesh is not None and self.mesh.size > 1:
             # per-device balance at the same sync point: PGD runs every
             # row to the full budget, so the engine's view is uniform —

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -68,7 +69,16 @@ class ArtifactCache:
             if entry is not None:
                 self.evictions += 1
             self.misses += 1
+            t0 = time.perf_counter()
             value = builder()
+            # cold-start decomposition: artifact build/load wall-clock is
+            # a named phase of the process's cold path (misses only — a
+            # hit is the amortisation working)
+            from ..observability.coldstart import get_coldstart
+
+            get_coldstart().record_phase(
+                "artifact_build", time.perf_counter() - t0
+            )
             self._entries[key] = (stamp, value)
             return value
 
@@ -194,25 +204,43 @@ def setup_jax_cache(config: dict | None = None) -> None:
     per program shape; an rq grid revisits the same handful of shapes across
     many processes). ``system.jax_cache_dir: ""`` disables.
 
-    Also applies ``system.cost_ledger`` and ``system.mesh_telemetry``
-    (both default on): this is the one process-level setup hook every
-    runner and bench path already calls."""
+    Also applies ``system.cost_ledger``, ``system.mesh_telemetry``, and
+    ``system.gap_telemetry`` (all default on): this is the one
+    process-level setup hook every runner and bench path already calls —
+    which also makes it the cold-start ledger's "imports are done" marker."""
+    from ..observability.coldstart import configure_coldstart
+    from ..observability.gaps import configure_gap_tracker
     from ..observability.ledger import configure_ledger
     from ..observability.mesh import configure_mesh_capture
 
     configure_ledger(config)
     configure_mesh_capture(config)
+    configure_gap_tracker(config)
+    coldstart = configure_coldstart(config)
+    coldstart.note_import_complete()
     import jax
 
     cache_dir = ".jax_cache"
     if config is not None:
         cache_dir = config.get("system", {}).get("jax_cache_dir", cache_dir)
     if not cache_dir:
+        coldstart.configure_cache(None, False)
         return
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        coldstart.configure_cache(cache_dir, True)
     except Exception as e:  # never let cache plumbing break an experiment
+        # a swallowed failure must still be observable: a counted recorder
+        # event plus structured state (dir + fallback + error) that the
+        # cold-start ledger carries onto /healthz ``build.jax_cache`` —
+        # every later compile in this process is a silent cache miss, and
+        # that is exactly the cold-start regression the gap/cold telemetry
+        # exists to attribute
+        from ..observability.trace import default_recorder
+
+        default_recorder().count("jax_cache_setup_failures")
+        coldstart.configure_cache(cache_dir, False, error=repr(e))
         print(f"persistent compilation cache unavailable: {e}")
 
 

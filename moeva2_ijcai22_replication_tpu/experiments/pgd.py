@@ -31,6 +31,7 @@ from ..attacks.sharding import describe_mesh
 from ..domains import augmentation
 from ..observability import (
     Trace,
+    get_gap_tracker,
     get_ledger,
     get_mesh_capture,
     quality_block,
@@ -124,9 +125,11 @@ def run(config: dict, pipeline=None):
     timer = PhaseTimer(trace=trace)
     # cost-ledger window: the metrics' telemetry.cost reports THIS run's
     # executables/compiles, not the process lifetime (shared-engine grids);
-    # the mesh-balance mark scopes telemetry.mesh the same way
+    # the mesh-balance and dispatch-gap marks scope telemetry.mesh and
+    # telemetry.gaps the same way
     ledger_mark = get_ledger().mark()
     mesh_mark = get_mesh_capture().mark()
+    gaps_mark = get_gap_tracker().mark()
     apply_sat = "sat" in config["loss_evaluation"]
 
     with timer.phase("setup"):
@@ -286,6 +289,7 @@ def run(config: dict, pipeline=None):
                 if attack.mesh is not None
                 else None,
                 ledger_since=ledger_mark,
+                gaps_since=gaps_mark,
                 # multi-device runs carry telemetry.mesh (per-device
                 # roofline + balance + collectives), window-scoped
                 mesh=describe_mesh(attack.mesh),

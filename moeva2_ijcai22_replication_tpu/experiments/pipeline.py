@@ -40,6 +40,8 @@ import time
 
 from ..observability import (
     TraceRecorder,
+    get_coldstart,
+    get_gap_tracker,
     get_ledger,
     get_mesh_capture,
     quality_block,
@@ -85,6 +87,10 @@ class GridPipeline:
         self._ledger0 = get_ledger().summary()
         self._ledger_mark = get_ledger().mark()
         self._mesh_mark = get_mesh_capture().mark()
+        # dispatch-gap window: the report's telemetry.gaps covers this
+        # sweep's device timeline (incl. the idle seams between points
+        # that the background writer exists to fill)
+        self._gaps_mark = get_gap_tracker().mark()
 
     # -- background writer ---------------------------------------------------
     def _worker(self):
@@ -189,6 +195,35 @@ class GridPipeline:
             )
 
         launched = [p for p in points if not p["skipped"]]
+        # cold-start decomposition at grid scale: the first launched
+        # point's attack wall-clock (compiles / persistent-cache loads
+        # land there) vs the steady cost of the rest — the grid analogue
+        # of bench.py's cold_s/steady_s pair, plus the process-wide
+        # startup-phase ledger (import, artifact builds, lower-vs-compile
+        # split, per-executable persistent-cache hit/miss counts)
+        attack_walls = [
+            p.get("spans", {}).get("attack")
+            for p in launched
+            if isinstance(p.get("spans", {}).get("attack"), (int, float))
+        ]
+        steady_walls = sorted(attack_walls[1:])
+        steady_attack = (
+            steady_walls[len(steady_walls) // 2] if steady_walls else None
+        )
+        cold_block = {
+            "first_point_attack_s": (
+                round(attack_walls[0], 4) if attack_walls else None
+            ),
+            "steady_point_attack_s": (
+                round(steady_attack, 4) if steady_attack is not None else None
+            ),
+            "cold_steady_ratio": (
+                round(attack_walls[0] / steady_attack, 3)
+                if attack_walls and steady_attack
+                else None
+            ),
+            "process": get_coldstart().cold_block(),
+        }
         # resolve the grid's mesh identity (config mesh_devices may be -1 =
         # all visible devices): the execution block records the RESOLVED
         # count and multi-device grids carry telemetry.mesh
@@ -219,6 +254,7 @@ class GridPipeline:
             # this grid's executable-cost footprint (satellite of the cost
             # ledger: report next to the cache deltas it explains)
             "ledger": get_ledger().summary_delta(self._ledger0),
+            "cold": cold_block,
             "writer": {
                 "submitted": self._submitted,
                 "failures": self.write_failures,
@@ -239,6 +275,7 @@ class GridPipeline:
             "telemetry": telemetry_block(
                 recorder=self.recorder,
                 ledger_since=self._ledger_mark,
+                gaps_since=self._gaps_mark,
                 mesh=mesh_desc,
                 mesh_since=self._mesh_mark,
                 # grid-level quality: per-point interior/final summaries

@@ -24,6 +24,27 @@ keep every committed record carrying the shared ``execution`` +
 """
 
 from .capacity import CapacityModel
+from .coldstart import (
+    COLD_KEYS,
+    COLDSTART,
+    ColdStartLedger,
+    configure_coldstart,
+    get_coldstart,
+    validate_cold,
+)
+from .gaps import (
+    GAPS,
+    GAPS_KEYS,
+    DispatchWindow,
+    GapTracker,
+    configure_gap_tracker,
+    emit_window_trace,
+    get_gap_tracker,
+    join_gaps_to_spans,
+    spans_from_recorder,
+    spans_from_trace,
+    validate_gaps,
+)
 from .ledger import (
     LEDGER,
     CostLedger,
@@ -87,8 +108,12 @@ from .trace import (
 )
 
 __all__ = [
+    "COLD_KEYS",
+    "COLDSTART",
     "DEFAULT_INTERIOR_BUDGETS",
     "DEFAULT_LATENCY_BUCKETS",
+    "GAPS",
+    "GAPS_KEYS",
     "HOT_LOOP_PRODUCERS",
     "LEDGER",
     "MESH",
@@ -99,7 +124,10 @@ __all__ = [
     "SLO_KEYS",
     "STAGES",
     "CapacityModel",
+    "ColdStartLedger",
     "CostLedger",
+    "DispatchWindow",
+    "GapTracker",
     "Histogram",
     "LedgerEntry",
     "LedgeredJit",
@@ -109,6 +137,8 @@ __all__ = [
     "TraceRecorder",
     "all_device_memory_stats",
     "build_identity",
+    "configure_coldstart",
+    "configure_gap_tracker",
     "configure_ledger",
     "configure_mesh_capture",
     "current_ledger_context",
@@ -116,9 +146,13 @@ __all__ = [
     "default_recorder",
     "detect_knee",
     "device_memory_stats",
+    "emit_window_trace",
+    "get_coldstart",
+    "get_gap_tracker",
     "get_ledger",
     "get_mesh_capture",
     "interior_summary",
+    "join_gaps_to_spans",
     "ledger_context",
     "maybe_span",
     "merge_chunk_quality",
@@ -130,9 +164,13 @@ __all__ = [
     "recorder_for",
     "sample_from_per_state",
     "slo_block",
+    "spans_from_recorder",
+    "spans_from_trace",
     "telemetry_block",
     "trim_quality",
     "use_trace",
+    "validate_cold",
+    "validate_gaps",
     "validate_mesh",
     "validate_quality",
     "validate_record",

@@ -1,0 +1,353 @@
+"""Startup-phase ledger: where a process's cold seconds actually go.
+
+ROADMAP item 2 names cold start as a first-class perf sink (r05: 17.4 s
+cold vs 10.5 s steady; 406 jax cache entries rebuilt per process) but the
+observability stack so far reports cold wall-clock as ONE number
+(``cold_s``). This module decomposes it — the prerequisite for spending
+the optimisation budget (persistent AOT cache, warmup overlap) on the
+right phase:
+
+- **Phases** — a process-wide :class:`ColdStartLedger` accumulating named
+  phase seconds: ``import`` (package import + process setup, measured
+  from this module's import instant to the first ``setup_jax_cache``
+  call — the one process-level hook every runner/bench/serving path
+  already makes), ``artifact_build`` (ArtifactCache misses' builder
+  wall-clock), ``trace_lower`` and ``xla_compile`` (the
+  :class:`~.ledger.LedgeredJit` compile split), ``device_warmup``
+  (explicit warmup dispatches the producers bracket), plus
+  ``time_to_first_dispatch_s`` — module-import epoch to the first
+  compiled-program dispatch.
+
+- **Persistent-cache accounting** — every AOT compile is classified
+  against ``setup_jax_cache``'s directory: ``hit`` (loaded from the
+  persistent cache — jax's ``/jax/compilation_cache/cache_hits``
+  monitoring event, registered when available), ``miss_stored`` (a real
+  XLA compile whose entry landed in the cache dir — new files appeared),
+  ``miss_uncached`` (compiled but below the persistence threshold, or
+  classified by the monitoring miss event), ``unknown`` (no signal
+  either way), ``disabled`` (no cache dir configured). The cache-dir
+  entry counts (start / now / added) surface the "N entries rebuilt per
+  process" number directly. Classification is best-effort and documented
+  approximate: monitoring deltas are process-global, so a concurrent
+  compile on another thread can mislabel one entry — the aggregate
+  hit/miss counters stay exact.
+
+- **Cache health** — ``setup_jax_cache`` reports its outcome here
+  (dir, enabled, error) instead of swallowing failures in a bare print;
+  /healthz ``build`` surfaces the state and the failure is a counted
+  recorder event.
+
+Capture rides ``system.gap_telemetry`` (one knob for the
+device-utilization + cold-start pair): a few dict writes per *compile*
+and per artifact build — never per dispatch — so on/off adds zero
+compiles/dispatches and results stay bit-identical (tier-1 smoke in
+``tests/test_gaps.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+#: as close to process start as importing this package gets: the
+#: observability package imports this module at its own import, which the
+#: engines/runners pull in before any device work.
+_IMPORT_EPOCH = time.perf_counter()
+
+#: bounded per-executable classification rows (serving uptime).
+MAX_EXECUTABLES = 256
+
+
+def _cache_dir_entries(path: str | None) -> int | None:
+    if not path:
+        return None
+    try:
+        return sum(1 for _ in os.scandir(path))
+    except FileNotFoundError:
+        # configured but not yet created (jax creates it lazily on the
+        # first persisted entry): zero entries, not "unknown"
+        return 0
+    except OSError:
+        return None
+
+
+class ColdStartLedger:
+    """Process-wide startup-phase + persistent-cache accounting."""
+
+    def __init__(self, enabled: bool = True, clock=None):
+        self._lock = threading.Lock()
+        self.enabled = enabled
+        self.clock = clock or time.perf_counter
+        self.epoch = _IMPORT_EPOCH
+        self.phases: dict[str, float] = {}
+        self.phase_counts: dict[str, int] = {}
+        self._import_noted = False
+        # persistent-cache state (setup_jax_cache reports here)
+        self.cache_dir: str | None = None
+        self.cache_enabled: bool | None = None
+        self.cache_error: str | None = None
+        self.cache_entries_start: int | None = None
+        # jax monitoring counters (exact process-wide hit/miss totals)
+        self._jax_hits = 0
+        self._jax_misses = 0
+        self._listener_registered = False
+        # per-executable classification rows
+        self.executables: list[dict] = []
+        self._first_dispatch: dict | None = None
+
+    # -- phases --------------------------------------------------------------
+    def record_phase(self, name: str, seconds: float) -> None:
+        if not self.enabled or seconds is None or seconds < 0:
+            return
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+            self.phase_counts[name] = self.phase_counts.get(name, 0) + 1
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            self.record_phase(name, self.clock() - t0)
+
+    def note_import_complete(self) -> None:
+        """First call wins: the span from package import to the process's
+        setup hook approximates import + python-side init cost."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._import_noted:
+                return
+            self._import_noted = True
+            self.phases["import"] = self.clock() - self.epoch
+            self.phase_counts["import"] = 1
+
+    # -- persistent cache ----------------------------------------------------
+    def configure_cache(
+        self, cache_dir: str | None, enabled: bool, error: str | None = None
+    ) -> None:
+        """``setup_jax_cache`` reports its outcome (satellite: no more
+        swallowed failures — the state surfaces on /healthz ``build``)."""
+        with self._lock:
+            self.cache_dir = cache_dir
+            self.cache_enabled = bool(enabled)
+            self.cache_error = error
+            if self.cache_entries_start is None:
+                self.cache_entries_start = _cache_dir_entries(cache_dir)
+        if enabled and not self._listener_registered:
+            self._register_jax_listener()
+
+    def _register_jax_listener(self) -> None:
+        """Count jax's own persistent-cache hit/miss monitoring events —
+        exact totals, available on jax >= 0.4.30; degrade silently
+        otherwise (the dir-diff classification still works)."""
+        try:
+            from jax import monitoring
+
+            def _listener(event, *args, **kw):
+                if event == "/jax/compilation_cache/cache_hits":
+                    with self._lock:
+                        self._jax_hits += 1
+                elif event == "/jax/compilation_cache/cache_misses":
+                    with self._lock:
+                        self._jax_misses += 1
+
+            monitoring.register_event_listener(_listener)
+            self._listener_registered = True
+        except Exception:
+            pass
+
+    def compile_probe(self) -> dict:
+        """Pre-compile snapshot for :meth:`note_compile`'s per-executable
+        classification (monitoring counters + cache-dir entry count)."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            return {
+                "hits": self._jax_hits,
+                "misses": self._jax_misses,
+                "entries": _cache_dir_entries(
+                    self.cache_dir if self.cache_enabled else None
+                ),
+            }
+
+    def note_compile(
+        self,
+        *,
+        producer: str,
+        key: str | None,
+        lower_s: float,
+        compile_s: float,
+        probe: dict | None = None,
+        aot: bool = True,
+    ) -> str:
+        """Record one AOT compile's phase split and classify it against
+        the persistent cache; returns the classification."""
+        if not self.enabled:
+            return "off"
+        self.record_phase("trace_lower", lower_s)
+        self.record_phase("xla_compile", compile_s)
+        probe = probe or {}
+        with self._lock:
+            if not aot:
+                outcome = "fallback"
+            elif not self.cache_enabled or not self.cache_dir:
+                outcome = "disabled"
+            elif self._listener_registered:
+                if self._jax_hits > probe.get("hits", self._jax_hits):
+                    outcome = "hit"
+                elif self._jax_misses > probe.get(
+                    "misses", self._jax_misses
+                ):
+                    outcome = "miss_uncached"
+                else:
+                    outcome = "unknown"
+            else:
+                outcome = "unknown"
+            if outcome in ("miss_uncached", "unknown"):
+                entries_now = _cache_dir_entries(self.cache_dir)
+                before = probe.get("entries")
+                if (
+                    entries_now is not None
+                    and before is not None
+                    and entries_now > before
+                ):
+                    outcome = "miss_stored"
+            self.executables.append(
+                {
+                    "key": key,
+                    "producer": producer,
+                    "lower_s": round(lower_s, 4),
+                    "compile_s": round(compile_s, 4),
+                    "persistent_cache": outcome,
+                }
+            )
+            del self.executables[:-MAX_EXECUTABLES]
+        return outcome
+
+    def note_dispatch(self) -> None:
+        """First compiled-program dispatch of the process (cheap: one
+        None-check per call at the LedgeredJit dispatch site)."""
+        if not self.enabled or self._first_dispatch is not None:
+            return
+        with self._lock:
+            if self._first_dispatch is None:
+                self._first_dispatch = {
+                    "since_import_s": round(self.clock() - self.epoch, 4),
+                    "wall": time.time(),
+                }
+
+    # -- export --------------------------------------------------------------
+    def cache_state(self) -> dict:
+        """The /healthz ``build.jax_cache`` view: dir, enabled/fallback
+        state, the setup error if any, and the entry counts that surface
+        the 'N entries rebuilt per process' number."""
+        with self._lock:
+            now = _cache_dir_entries(self.cache_dir)
+            return {
+                "dir": self.cache_dir,
+                "enabled": self.cache_enabled,
+                "error": self.cache_error,
+                "entries_start": self.cache_entries_start,
+                "entries_now": now,
+                "entries_added": (
+                    now - self.cache_entries_start
+                    if now is not None and self.cache_entries_start is not None
+                    else None
+                ),
+            }
+
+    def cold_block(self) -> dict:
+        """The structured ``cold`` breakdown a bench record embeds next
+        to ``cold_s`` (and /healthz serves as the replica warmup report):
+        phase seconds, per-executable persistent-cache hit/miss counts,
+        cache health, and time-to-first-dispatch."""
+        if not self.enabled:
+            return {"enabled": False}
+        with self._lock:
+            phases = {k: round(v, 4) for k, v in self.phases.items()}
+            counts = dict(self.phase_counts)
+            rows = [dict(r) for r in self.executables]
+            first = dict(self._first_dispatch) if self._first_dispatch else None
+            hits, misses = self._jax_hits, self._jax_misses
+            listener = self._listener_registered
+        outcome_counts: dict[str, int] = {}
+        for r in rows:
+            o = r["persistent_cache"]
+            outcome_counts[o] = outcome_counts.get(o, 0) + 1
+        return {
+            "enabled": True,
+            "phases": phases,
+            "phase_counts": counts,
+            "persistent_cache": {
+                **self.cache_state(),
+                "monitoring": listener,
+                "hits": hits,
+                "misses": misses,
+                "by_outcome": outcome_counts,
+                "by_executable": rows,
+            },
+            "first_dispatch": first,
+            "time_to_first_dispatch_s": (
+                first["since_import_s"] if first else None
+            ),
+        }
+
+    def reset(self) -> None:
+        """Drop all state (tests only). The import epoch and the jax
+        listener registration survive — both are process facts."""
+        with self._lock:
+            self.phases = {}
+            self.phase_counts = {}
+            self._import_noted = False
+            self.cache_dir = None
+            self.cache_enabled = None
+            self.cache_error = None
+            self.cache_entries_start = None
+            self._jax_hits = 0
+            self._jax_misses = 0
+            self.executables = []
+            self._first_dispatch = None
+
+
+#: keys a capture-on ``cold`` breakdown must carry.
+COLD_KEYS = ("phases", "persistent_cache", "time_to_first_dispatch_s")
+
+
+def validate_cold(block, kind: str = "record") -> dict:
+    """Assert a structured ``cold`` breakdown is well-formed; returns it."""
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} record's cold breakdown must be a dict, got "
+            f"{type(block).__name__}"
+        )
+    if block.get("enabled") is False:
+        return block
+    missing = [k for k in COLD_KEYS if k not in block]
+    if missing:
+        raise ValueError(
+            f"{kind} record's cold breakdown is missing {missing}: "
+            "assemble it with observability.coldstart.cold_block so the "
+            "startup-phase decomposition travels with every cold number"
+        )
+    return block
+
+
+#: THE process ledger — the startup path is process-scoped by nature.
+COLDSTART = ColdStartLedger()
+
+
+def get_coldstart() -> ColdStartLedger:
+    return COLDSTART
+
+
+def configure_coldstart(config: dict | None) -> ColdStartLedger:
+    """Apply config ``system.gap_telemetry`` (shared knob with the
+    dispatch-gap tracker: one switch for the device-utilization +
+    cold-start observability pair)."""
+    enabled = (config or {}).get("system", {}).get("gap_telemetry", True)
+    COLDSTART.enabled = bool(enabled)
+    return COLDSTART

@@ -1,0 +1,552 @@
+"""Dispatch-gap ledger: how much device time the host leaves on the table.
+
+The cost ledger (PR 5) says what each executable *costs* and the engines
+attribute run seconds at their sync points — but nothing so far says how
+much of a run's wall-clock the device spent *idle*, waiting for host-side
+serial work between dispatches (ROADMAP item 2's second perf sink, next
+to cold start). This module makes that idle time first-class:
+
+- :class:`GapTracker` — a process-wide monotonic dispatch timeline. Each
+  engine ``generate`` contributes one :class:`DispatchWindow` at its
+  *existing* sync point (MoEvA's ``_attribute_run`` after the final
+  fetch, PGD's post-fetch attribution — zero new device syncs): the
+  window's wall span, its per-dispatch enqueue timestamps (the
+  :class:`~.ledger.LedgeredJit` call instants, host-side ``perf_counter``
+  reads the dispatch path already makes), the attributed run seconds per
+  dispatch, and the compile seconds. From those the tracker derives the
+  window's device-busy intervals (a serial device queue: each dispatch's
+  run follows the later of its enqueue and the previous dispatch's
+  completion) and therefore its **gaps** — intervals where the device had
+  nothing queued. The model is an approximation by construction (run
+  seconds are the engines' aggregate attribution, not per-op device
+  timestamps) and is documented as such; its error is bounded by the
+  attribution error the roofline already carries.
+
+- **Gap attribution** — :func:`join_gaps_to_spans` joins gap intervals
+  against the host spans active during them (the ``TraceRecorder`` span
+  tree: fetch / decode / parked_merge / gate_fetch / evaluate / write /
+  queue_wait / batch_wait…). Each gap instant is attributed to the most
+  specific (shortest) covering span; uncovered time lands in the honest
+  ``unattributed`` bucket (spans off ⇒ everything unattributed — capture
+  degrades, it never lies).
+
+- **Overlap ratio** — device-busy / wall per window, per producer, per
+  executable, and per run scope: the single number that says "the device
+  worked 62% of this run's wall-clock; the top gap stage was
+  parked_merge". ``mark()``/``gaps_block(since=)`` window-scope exports
+  exactly like ``CostLedger.mark`` so a record reports *its own* runs.
+
+Capture (config ``system.gap_telemetry``, default on) is pure host-side
+bookkeeping on clock reads the dispatch path already makes: on/off adds
+zero compiles, zero dispatches, and results stay bit-identical (tier-1
+smoke in ``tests/test_gaps.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+
+#: keys a capture-on ``telemetry.gaps`` block must carry
+#: (``records.validate_record`` enforces the block on every
+#: bench/grid/serving/runner record, mirroring telemetry.cost/quality).
+GAPS_KEYS = ("windows", "busy_s", "overlap_ratio", "attributed")
+
+#: longest gaps listed individually in a gaps block (aggregates cover the
+#: rest — the block must not grow with run length).
+MAX_GAPS_LISTED = 8
+
+#: longest gaps fed through the span join per block assembly: the join is
+#: O(gaps x spans) and a long-lived serving process accumulates both, so
+#: a /metrics scrape must not walk every tiny gap of the replica's
+#: lifetime. Idle beyond the joined subset stays counted (idle_s is
+#: computed independently); only its attribution is foregone.
+MAX_GAPS_JOINED = 1024
+
+
+@dataclass
+class DispatchWindow:
+    """One engine run on the device timeline: wall span, busy/compile
+    seconds, and the derived idle gaps."""
+
+    seq: int
+    producer: str
+    engine: str | None
+    start: float
+    end: float
+    busy_s: float
+    compile_s: float
+    dispatches: int
+    #: ledger entry key -> attributed busy seconds within this window
+    executables: dict = field(default_factory=dict)
+    #: (start, dur) idle intervals inside the window, tracker clock base
+    gaps: list = field(default_factory=list)
+
+    @property
+    def wall_s(self) -> float:
+        return max(self.end - self.start, 0.0)
+
+    def overlap_ratio(self) -> float | None:
+        """Busy over compile-free wall: compile seconds are the cold
+        ledger's business, and folding them into the denominator would
+        make every cold window read as a host-stall problem."""
+        w = self.wall_s - self.compile_s
+        return min(self.busy_s / w, 1.0) if w > 0 else None
+
+
+def _window_intervals(start, end, dispatches):
+    """Derive (busy, compile, gap) intervals of one window from its
+    dispatch log ``[(enqueue_ts, run_s, compile_s), ...]``, where
+    ``enqueue_ts`` is the POST-compile enqueue instant (the LedgeredJit
+    call returns after any compile, so that clock read sits right after
+    both) — a compile therefore occupied ``[enqueue_ts - compile_s,
+    enqueue_ts]``.
+
+    Serial-device-queue model: a dispatch's device run begins at the
+    later of its enqueue and the previous dispatch's completion, so
+    back-to-back async dispatches show zero gap even though the host
+    enqueued them long before they ran. Everything neither busy nor
+    compiling is a gap. All host-side arithmetic on clock reads the
+    dispatch path already made."""
+    busy, compile_iv, gaps = [], [], []
+    cursor = start
+    for ts, run_s, compile_s in sorted(dispatches):
+        ts = min(max(ts, start), end)
+        if compile_s > 0:
+            c0 = max(ts - compile_s, start, cursor)
+            if ts > c0:
+                compile_iv.append((c0, ts - c0))
+            if c0 > cursor:
+                gaps.append((cursor, c0 - cursor))
+            cursor = max(cursor, ts)
+        b0 = max(ts, cursor)
+        if b0 > cursor:
+            gaps.append((cursor, b0 - cursor))
+        b1 = min(b0 + max(run_s, 0.0), end)
+        if b1 > b0:
+            busy.append((b0, b1 - b0))
+        cursor = max(cursor, b1)
+    if cursor < end:
+        gaps.append((cursor, end - cursor))
+    return busy, compile_iv, gaps
+
+
+def join_gaps_to_spans(gaps, spans) -> dict:
+    """Attribute idle intervals to the host spans active during them.
+
+    ``gaps`` is ``[(start, dur), ...]``; ``spans`` is ``[{"name",
+    "start", "dur"}, ...]`` in the SAME clock base. Each gap instant goes
+    to the most specific covering span (shortest duration wins — in a
+    span tree the child is always shorter than its parent, so "decode"
+    beats the enclosing "dispatch" envelope); uncovered time lands in
+    ``unattributed_s``. Returns ``{"attributed": {name: seconds},
+    "unattributed_s", "per_gap": [{"start", "dur", "top"}, ...]}``."""
+    attributed: dict[str, float] = {}
+    per_gap = []
+    ordered = sorted(
+        (s for s in spans or () if s.get("dur", 0) > 0),
+        key=lambda s: s["dur"],
+    )
+    unattributed = 0.0
+    for g0, gdur in gaps:
+        g1 = g0 + gdur
+        remaining = [(g0, g1)]
+        gap_attr: dict[str, float] = {}
+        for s in ordered:
+            if not remaining:
+                break
+            s0, s1 = s["start"], s["start"] + s["dur"]
+            nxt = []
+            for r0, r1 in remaining:
+                o0, o1 = max(r0, s0), min(r1, s1)
+                if o1 > o0:
+                    name = str(s.get("name", "?"))
+                    gap_attr[name] = gap_attr.get(name, 0.0) + (o1 - o0)
+                    if r0 < o0:
+                        nxt.append((r0, o0))
+                    if o1 < r1:
+                        nxt.append((o1, r1))
+                else:
+                    nxt.append((r0, r1))
+            remaining = nxt
+        left = sum(r1 - r0 for r0, r1 in remaining)
+        unattributed += left
+        for name, sec in gap_attr.items():
+            attributed[name] = attributed.get(name, 0.0) + sec
+        top = max(gap_attr.items(), key=lambda kv: kv[1])[0] if gap_attr else None
+        per_gap.append(
+            {
+                "start": round(g0, 6),
+                "dur": round(gdur, 6),
+                "top": top,
+            }
+        )
+    return {
+        "attributed": {k: round(v, 6) for k, v in attributed.items()},
+        "unattributed_s": round(unattributed, 6),
+        "per_gap": per_gap,
+    }
+
+
+#: span names never used as attribution targets: the tracker's own
+#: ``device_gap`` slices coincide with the gaps by construction and would
+#: otherwise claim 100% of the attribution they exist to visualize.
+_SELF_SPANS = ("device_gap",)
+
+
+def spans_from_trace(trace) -> list[dict]:
+    """Span events of a :class:`~.trace.Trace`, converted to the gap
+    tracker's clock base (recorder-relative ts + the recorder's
+    perf-counter epoch). Empty when the trace is off — gaps then stay
+    honestly unattributed."""
+    if trace is None or not getattr(trace, "enabled", False):
+        return []
+    epoch = getattr(trace.recorder, "perf_epoch", 0.0)
+    return [
+        {
+            "name": ev.get("name"),
+            "start": float(ev.get("ts", 0.0)) + epoch,
+            "dur": float(ev.get("dur", 0.0)),
+        }
+        for ev in trace.events
+        if ev.get("kind") == "span" and ev.get("name") not in _SELF_SPANS
+    ]
+
+
+def spans_from_recorder(recorder) -> list[dict]:
+    """Span events currently in a recorder's ring, in the tracker's clock
+    base — the serving/grid producers' attribution source (one recorder,
+    many traces)."""
+    if recorder is None:
+        return []
+    epoch = getattr(recorder, "perf_epoch", 0.0)
+    return [
+        {
+            "name": ev.get("name"),
+            "start": float(ev.get("ts", 0.0)) + epoch,
+            "dur": float(ev.get("dur", 0.0)),
+        }
+        for ev in recorder.events()
+        if ev.get("kind") == "span" and ev.get("name") not in _SELF_SPANS
+    ]
+
+
+class GapTracker:
+    """Process-wide dispatch timeline + device busy/idle accounting."""
+
+    def __init__(self, enabled: bool = True, capacity: int = 4096, clock=None):
+        self._lock = threading.Lock()
+        self.enabled = enabled
+        self.clock = clock or time.perf_counter
+        self._windows: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+        # cumulative totals survive ring eviction (serving uptime)
+        self._busy_s = 0.0
+        self._compile_s = 0.0
+        self._wall_s = 0.0
+        self._by_producer: dict[str, dict] = {}
+
+    # -- recording -----------------------------------------------------------
+    def record_window(
+        self,
+        *,
+        producer: str,
+        start: float,
+        end: float,
+        dispatches,
+        engine: str | None = None,
+    ) -> DispatchWindow | None:
+        """Register one engine run's window at its existing sync point.
+
+        ``dispatches`` is ``[(enqueue_ts, run_s, compile_s, executable_key
+        or None), ...]`` — the clock reads the dispatch path already made.
+        Returns the window (None when capture is off, or the span is
+        degenerate) so the caller can emit its Perfetto events."""
+        if not self.enabled or end <= start:
+            return None
+        disp3 = [(ts, r, c) for ts, r, c, _ in dispatches]
+        busy_iv, compile_iv, gap_iv = _window_intervals(start, end, disp3)
+        executables: dict[str, float] = {}
+        for _, r, _, key in dispatches:
+            if key is not None and r > 0:
+                executables[key] = executables.get(key, 0.0) + r
+        busy = sum(d for _, d in busy_iv)
+        compile_s = sum(d for _, d in compile_iv)
+        with self._lock:
+            self._seq += 1
+            w = DispatchWindow(
+                seq=self._seq,
+                producer=str(producer),
+                engine=engine,
+                start=start,
+                end=end,
+                busy_s=busy,
+                compile_s=compile_s,
+                dispatches=len(dispatches),
+                executables=executables,
+                gaps=gap_iv,
+            )
+            self._windows.append(w)
+            self._busy_s += busy
+            self._compile_s += compile_s
+            self._wall_s += w.wall_s
+            slot = self._by_producer.setdefault(
+                w.producer, {"windows": 0, "busy_s": 0.0, "wall_s": 0.0}
+            )
+            slot["windows"] += 1
+            slot["busy_s"] += busy
+            # compile-free wall, matching the overlap-ratio basis
+            slot["wall_s"] += max(w.wall_s - compile_s, 0.0)
+        return w
+
+    # -- windowing -----------------------------------------------------------
+    def mark(self) -> dict:
+        """Opaque snapshot for window-scoped gaps blocks
+        (``gaps_block(since=mark)``) — the ``CostLedger.mark`` discipline."""
+        with self._lock:
+            return {
+                "seq": self._seq,
+                "busy_s": self._busy_s,
+                "compile_s": self._compile_s,
+                "wall_s": self._wall_s,
+            }
+
+    # -- export --------------------------------------------------------------
+    def gaps_block(self, since: dict | None = None, spans=None) -> dict:
+        """The ``telemetry.gaps`` sub-block every record carries: window
+        count, busy/compile/idle seconds, the overlap ratio (device-busy /
+        wall), per-producer and per-executable ratios, the longest gaps,
+        and the gap↔span attribution (``spans`` in the tracker clock base
+        — see :func:`spans_from_trace`). Wall is the contiguous span from
+        the first window's start to the last window's end in scope, so
+        inter-window idle (grid writer, batch assembly between runs)
+        counts as gap time too."""
+        if not self.enabled:
+            return {"enabled": False}
+        min_seq = (since or {}).get("seq", 0)
+        with self._lock:
+            windows = [w for w in self._windows if w.seq > min_seq]
+        if not windows:
+            return {
+                "enabled": True,
+                "windows": 0,
+                "wall_s": 0.0,
+                "busy_s": 0.0,
+                "compile_s": 0.0,
+                "idle_s": 0.0,
+                "overlap_ratio": None,
+                "by_producer": {},
+                "by_executable": {},
+                "gaps": [],
+                "attributed": {},
+                "unattributed_s": 0.0,
+                "top_gap_stages": [],
+            }
+        windows.sort(key=lambda w: w.start)
+        wall = max(windows[-1].end - windows[0].start, 0.0)
+        busy = sum(w.busy_s for w in windows)
+        compile_s = sum(w.compile_s for w in windows)
+        # intra-window gaps + the idle seams BETWEEN windows (host work
+        # separating two runs — the grid writer / batch-assembly stalls)
+        gaps = [g for w in windows for g in w.gaps]
+        cursor = windows[0].end
+        for w in windows[1:]:
+            if w.start > cursor:
+                gaps.append((cursor, w.start - cursor))
+            cursor = max(cursor, w.end)
+        gaps.sort()
+        idle = sum(d for _, d in gaps)
+        # bounded join: the longest gaps carry the attribution story; the
+        # un-joined tail stays in idle_s and lands in unattributed below
+        join_gaps = gaps
+        if len(join_gaps) > MAX_GAPS_JOINED:
+            join_gaps = sorted(gaps, key=lambda g: -g[1])[:MAX_GAPS_JOINED]
+        scope_spans = [
+            s
+            for s in spans or ()
+            if s["start"] + s["dur"] > windows[0].start
+            and s["start"] < windows[-1].end
+        ]
+        join = join_gaps_to_spans(join_gaps, scope_spans)
+        # per-producer / per-executable ratios over the compile-free wall
+        # of the windows they appear in (compile is the cold ledger's
+        # phase; the overlap ratio isolates host idle)
+        by_producer: dict[str, dict] = {}
+        by_executable: dict[str, dict] = {}
+        for w in windows:
+            active = max(w.wall_s - w.compile_s, 0.0)
+            p = by_producer.setdefault(
+                w.producer, {"windows": 0, "busy_s": 0.0, "wall_s": 0.0}
+            )
+            p["windows"] += 1
+            p["busy_s"] += w.busy_s
+            p["wall_s"] += active
+            for key, sec in w.executables.items():
+                e = by_executable.setdefault(
+                    key, {"windows": 0, "busy_s": 0.0, "wall_s": 0.0}
+                )
+                e["windows"] += 1
+                e["busy_s"] += sec
+                e["wall_s"] += active
+        for slot in list(by_producer.values()) + list(by_executable.values()):
+            slot["busy_s"] = round(slot["busy_s"], 6)
+            slot["wall_s"] = round(slot["wall_s"], 6)
+            slot["overlap_ratio"] = (
+                round(min(slot["busy_s"] / slot["wall_s"], 1.0), 4)
+                if slot["wall_s"] > 0
+                else None
+            )
+        top_stages = sorted(
+            join["attributed"].items(), key=lambda kv: -kv[1]
+        )[:3]
+        listed = sorted(
+            join["per_gap"], key=lambda g: -g["dur"]
+        )[:MAX_GAPS_LISTED]
+        return {
+            "enabled": True,
+            "windows": len(windows),
+            "wall_s": round(wall, 6),
+            "busy_s": round(busy, 6),
+            "compile_s": round(compile_s, 6),
+            "idle_s": round(idle, 6),
+            # busy over compile-free wall: a cold window's compile must
+            # not read as host idle (cold has its own ledger and gate)
+            "overlap_ratio": (
+                round(min(busy / (wall - compile_s), 1.0), 4)
+                if wall - compile_s > 0
+                else None
+            ),
+            "by_producer": by_producer,
+            "by_executable": by_executable,
+            "gaps": listed,
+            "attributed": join["attributed"],
+            # idle the join did NOT explain — covers both span-free gap
+            # time and the un-joined tail beyond MAX_GAPS_JOINED
+            "unattributed_s": round(
+                max(idle - sum(join["attributed"].values()), 0.0), 6
+            ),
+            # the exit artifact: which host stage to double-buffer next
+            "top_gap_stages": [[k, round(v, 6)] for k, v in top_stages],
+        }
+
+    def totals(self) -> dict:
+        """Eviction-proof lifetime totals on the per-window wall basis:
+        ``wall_s`` sums each window's own span, so idle BETWEEN engine
+        runs (a replica waiting for traffic) is not charged as host
+        stall — the right basis for process-lifetime scalars, where the
+        record-scope block's first-to-last span (which deliberately
+        counts inter-run seams of one contiguous run/sweep) is not."""
+        with self._lock:
+            active = self._wall_s - self._compile_s
+            return {
+                "windows": self._seq,
+                "busy_s": round(self._busy_s, 6),
+                "compile_s": round(self._compile_s, 6),
+                "wall_s": round(self._wall_s, 6),
+                "idle_s": round(max(active - self._busy_s, 0.0), 6),
+                "overlap_ratio": (
+                    round(min(self._busy_s / active, 1.0), 4)
+                    if active > 0
+                    else None
+                ),
+                # lifetime per-producer view (the ring-scoped block's
+                # by_producer forgets evicted windows; this never does)
+                "by_producer": {
+                    p: {
+                        "windows": s["windows"],
+                        "busy_s": round(s["busy_s"], 6),
+                        "wall_s": round(s["wall_s"], 6),
+                        "overlap_ratio": (
+                            round(min(s["busy_s"] / s["wall_s"], 1.0), 4)
+                            if s["wall_s"] > 0
+                            else None
+                        ),
+                    }
+                    for p, s in self._by_producer.items()
+                },
+            }
+
+    def snapshot(self, spans=None) -> dict:
+        """Process-lifetime view for /healthz and /metrics. Two clearly
+        separated bases: ``totals`` (eviction-proof, per-window wall —
+        idle between engine runs is NOT host stall) for the replica-level
+        scalars, and ``recent`` (the ring-scoped block, first-to-last
+        span basis, with the gap list + span attribution) for detail.
+        Nesting them keeps a reader from computing one number across the
+        two bases — the one-request-then-idle-an-hour replica must not
+        read as a host-stall alarm."""
+        return {
+            "enabled": self.enabled,
+            "totals": self.totals(),
+            "recent": self.gaps_block(spans=spans),
+        }
+
+    def reset(self) -> None:
+        """Drop all state (tests only)."""
+        with self._lock:
+            self._windows.clear()
+            self._seq = 0
+            self._busy_s = self._compile_s = self._wall_s = 0.0
+            self._by_producer = {}
+
+
+def validate_gaps(block, kind: str = "record") -> dict:
+    """Assert a ``telemetry.gaps`` block is well-formed; returns it. A
+    capture-off block (``enabled: False``) passes — the knob may be off,
+    dropping the block entirely is not allowed."""
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} record's telemetry.gaps block must be a dict, got "
+            f"{type(block).__name__}"
+        )
+    if block.get("enabled") is False:
+        return block
+    missing = [k for k in GAPS_KEYS if k not in block]
+    if missing:
+        raise ValueError(
+            f"{kind} record's telemetry.gaps block is missing {missing}: "
+            "assemble it with observability.records.telemetry_block so "
+            "device busy/idle attribution travels with every committed "
+            "number"
+        )
+    return block
+
+
+def emit_window_trace(trace, window: DispatchWindow | None) -> None:
+    """Render one window into a run's trace: a ``device_busy_ratio``
+    counter sample (Perfetto 'C' track) plus one named ``device_gap``
+    slice per idle interval, positioned at its true timeline instant.
+    No-op when the trace is off or the window was not captured."""
+    if window is None or trace is None or not getattr(trace, "enabled", False):
+        return
+    rec = trace.recorder
+    epoch = getattr(rec, "perf_epoch", 0.0)
+    ratio = window.overlap_ratio()
+    if ratio is not None:
+        rec.gauge(
+            "device_busy_ratio", round(ratio, 4), at=window.end - epoch
+        )
+    for g0, gdur in window.gaps:
+        trace.record_span(
+            "device_gap", gdur, at=g0 - epoch, producer=window.producer
+        )
+
+
+#: THE process tracker — engines and record producers share it the way
+#: they share ``ledger.LEDGER`` and ``mesh.MESH``.
+GAPS = GapTracker()
+
+
+def get_gap_tracker() -> GapTracker:
+    return GAPS
+
+
+def configure_gap_tracker(config: dict | None) -> GapTracker:
+    """Apply config ``system.gap_telemetry`` (default on; the capture is a
+    few clock reads and dict writes per engine sync point, never a new
+    device sync)."""
+    enabled = (config or {}).get("system", {}).get("gap_telemetry", True)
+    GAPS.enabled = bool(enabled)
+    return GAPS

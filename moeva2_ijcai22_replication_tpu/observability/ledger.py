@@ -40,6 +40,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .coldstart import get_coldstart
+
 #: identity attrs pushed by an enclosing dispatch site (e.g. the serving
 #: microbatcher's bucket) into every entry compiled under it.
 _context: contextvars.ContextVar = contextvars.ContextVar(
@@ -687,9 +689,14 @@ class LedgeredJit:
     def _compile(self, args, kwargs):
         import jax
 
+        coldstart = get_coldstart()
+        # pre-compile snapshot for the persistent-cache classification
+        # (jax monitoring counters + cache-dir entry count)
+        probe = coldstart.compile_probe()
         t0 = time.perf_counter()
         try:
             lowered = self._jitted.lower(*args, **kwargs)
+            lower_s = time.perf_counter() - t0
             compiled = lowered.compile()
         except Exception:
             # AOT unavailable for this signature: plain jit dispatch —
@@ -704,6 +711,14 @@ class LedgeredJit:
                 memory=None,
                 aot=False,
             )
+            coldstart.note_compile(
+                producer=self.producer,
+                key=entry.key if entry is not None else None,
+                lower_s=compile_s,
+                compile_s=0.0,
+                probe=probe,
+                aot=False,
+            )
             return (None, entry, compile_s)
         compile_s = time.perf_counter() - t0
         entry = self._ledger.record_compile(
@@ -714,6 +729,16 @@ class LedgeredJit:
             cost=probe_cost_analysis(compiled),
             memory=probe_memory_analysis(compiled),
             mesh_probe=self._mesh_probe(compiled, lowered),
+        )
+        # cold-start decomposition: the lower-vs-XLA-compile split plus
+        # the persistent-cache hit/miss classification (host bookkeeping
+        # only — the compile above already happened identically)
+        coldstart.note_compile(
+            producer=self.producer,
+            key=entry.key if entry is not None else None,
+            lower_s=lower_s,
+            compile_s=max(compile_s - lower_s, 0.0),
+            probe=probe,
         )
         return (compiled, entry, compile_s)
 
@@ -733,6 +758,9 @@ class LedgeredJit:
     # -- dispatch ------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         self.calls += 1
+        # time-to-first-dispatch for the cold-start decomposition: one
+        # None-check per call after the first (never a device sync)
+        get_coldstart().note_dispatch()
         try:
             key = self._key(args, kwargs)
         except Exception:

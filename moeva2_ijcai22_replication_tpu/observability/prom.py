@@ -85,6 +85,19 @@ _HELP = {
     "collective_hot_loop_float_ops": "Collectives moving FLOAT payload inside hot-loop attack executables (states-sharding contract: must be 0)",
     "executable_per_device_flops": "Per-device model FLOPs per dispatch (whole-program cost split by states partitioning; replicated cost when unsharded)",
     "executable_per_device_bytes_accessed": "Per-device bytes accessed per dispatch (whole-program cost split by states partitioning)",
+    "overlap_ratio": "Device-busy seconds over wall seconds across recorded dispatch windows (1.0 = device never idle)",
+    "device_busy_s": "Device-busy seconds attributed across recorded dispatch windows (engines' sync points)",
+    "device_idle_s": "Device-idle gap seconds across recorded dispatch windows (host-side stalls between dispatches)",
+    "device_compile_windows_s": "Compile seconds inside recorded dispatch windows (excluded from busy AND idle)",
+    "gap_windows": "Engine runs recorded on the dispatch-gap timeline",
+    "gap_attributed_s": "Idle gap seconds attributed to a host span/stage active during the gap (recent window ring; pair with gap_unattributed_s, not the lifetime idle gauge)",
+    "gap_unattributed_s": "Idle gap seconds no recorded host span covers, over the same recent window ring as gap_attributed_s",
+    "producer_overlap_ratio": "Device-busy over compile-free wall per producer (pgd, moeva), lifetime per-window basis",
+    "coldstart_phase_s": "Startup-phase seconds by phase (import, artifact_build, trace_lower, xla_compile, device_warmup)",
+    "coldstart_persistent_cache_hits": "Persistent-compilation-cache hits observed by jax monitoring in this process",
+    "coldstart_persistent_cache_misses": "Persistent-compilation-cache misses observed by jax monitoring in this process",
+    "coldstart_cache_entries_added": "Entries this process added to the persistent compilation cache directory",
+    "coldstart_time_to_first_dispatch_s": "Seconds from package import to the first compiled-program dispatch",
 }
 
 
@@ -377,6 +390,95 @@ def _mesh_lines(prefix: str, block: dict, lines: list[str]) -> None:
             lines.append(f"{n} {_fmt(v)}")
 
 
+def _gaps_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Dispatch-gap exposition: overlap ratio + busy/idle scalar gauges
+    and the ``{producer}``-labeled per-producer family on the LIFETIME
+    per-window wall basis (idle between requests is not a host stall),
+    plus the ``{stage}``-labeled attributed / unattributed gap-seconds
+    pair on the ring-scoped recent basis — the two attribution gauges are
+    a self-consistent pair (compare them with each other, not with the
+    lifetime idle gauge). Accepts either a ``GapTracker.snapshot()``
+    (totals + recent) or a bare ``gaps_block``."""
+    if block.get("enabled") is False:
+        return
+    totals = block.get("totals") if isinstance(block.get("totals"), dict) else {}
+    recent = block.get("recent") if isinstance(block.get("recent"), dict) else block
+    for src, key in (
+        ("overlap_ratio", "overlap_ratio"),
+        ("busy_s", "device_busy_s"),
+        ("idle_s", "device_idle_s"),
+        ("compile_s", "device_compile_windows_s"),
+        ("windows", "gap_windows"),
+    ):
+        v = totals.get(src, recent.get(src))
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            n = _name(prefix, key)
+            _family(lines, n, "gauge", key)
+            lines.append(f"{n} {_fmt(v)}")
+    by_producer = totals.get("by_producer") or recent.get("by_producer") or {}
+    rows = [
+        (p, d.get("overlap_ratio"))
+        for p, d in sorted(by_producer.items())
+        if isinstance(d.get("overlap_ratio"), (int, float))
+    ]
+    if rows:
+        n = _name(prefix, "producer_overlap_ratio")
+        _family(lines, n, "gauge", "producer_overlap_ratio")
+        for p, v in rows:
+            lines.append(f'{n}{{producer="{_escape_label(p)}"}} {_fmt(v)}')
+    # attribution pair: both gauges read the SAME recent ring scope
+    v = recent.get("unattributed_s")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        n = _name(prefix, "gap_unattributed_s")
+        _family(lines, n, "gauge", "gap_unattributed_s")
+        lines.append(f"{n} {_fmt(v)}")
+    attributed = recent.get("attributed") or {}
+    rows = [
+        (stage, v)
+        for stage, v in sorted(attributed.items())
+        if isinstance(v, (int, float))
+    ]
+    if rows:
+        n = _name(prefix, "gap_attributed_s")
+        _family(lines, n, "gauge", "gap_attributed_s")
+        for stage, v in rows:
+            lines.append(f'{n}{{stage="{_escape_label(stage)}"}} {_fmt(v)}')
+
+
+def _coldstart_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Cold-start exposition: per-phase seconds (``{phase}``-labeled),
+    persistent-cache hit/miss counters, the entries-added-by-this-process
+    gauge (the 'N entries rebuilt' number), and time-to-first-dispatch."""
+    if block.get("enabled") is False:
+        return
+    phases = block.get("phases") or {}
+    rows = [
+        (p, v) for p, v in sorted(phases.items())
+        if isinstance(v, (int, float))
+    ]
+    if rows:
+        n = _name(prefix, "coldstart_phase_s")
+        _family(lines, n, "gauge", "coldstart_phase_s")
+        for p, v in rows:
+            lines.append(f'{n}{{phase="{_escape_label(p)}"}} {_fmt(v)}')
+    cache = block.get("persistent_cache") or {}
+    for src, key, mtype in (
+        ("hits", "coldstart_persistent_cache_hits", "counter"),
+        ("misses", "coldstart_persistent_cache_misses", "counter"),
+        ("entries_added", "coldstart_cache_entries_added", "gauge"),
+    ):
+        v = cache.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            n = _name(prefix, key, "_total" if mtype == "counter" else "")
+            _family(lines, n, mtype, key)
+            lines.append(f"{n} {_fmt(v)}")
+    ttfd = block.get("time_to_first_dispatch_s")
+    if isinstance(ttfd, (int, float)):
+        n = _name(prefix, "coldstart_time_to_first_dispatch_s")
+        _family(lines, n, "gauge", "coldstart_time_to_first_dispatch_s")
+        lines.append(f"{n} {_fmt(ttfd)}")
+
+
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     """ServiceMetrics snapshot dict -> Prometheus exposition text."""
     lines: list[str] = []
@@ -396,6 +498,12 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     mesh = snapshot.get("mesh")
     if isinstance(mesh, dict):
         _mesh_lines(prefix, mesh, lines)
+    gaps = snapshot.get("gaps")
+    if isinstance(gaps, dict):
+        _gaps_lines(prefix, gaps, lines)
+    coldstart = snapshot.get("coldstart")
+    if isinstance(coldstart, dict):
+        _coldstart_lines(prefix, coldstart, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
@@ -427,7 +535,7 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     for key, v in sorted(snapshot.items()):
         if key in (
             "counters", "gauges", "streams", "cost_ledger", "quality",
-            "slo", "capacity", "mesh",
+            "slo", "capacity", "mesh", "gaps", "coldstart",
         ):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):

@@ -12,6 +12,12 @@ asserts the producers keep calling it).
 
 from __future__ import annotations
 
+from .gaps import (
+    get_gap_tracker,
+    spans_from_recorder,
+    spans_from_trace,
+    validate_gaps,
+)
 from .ledger import get_ledger
 from .mesh import mesh_block, validate_mesh
 from .quality import quality_block, validate_quality
@@ -39,6 +45,7 @@ def telemetry_block(
     slo: dict | None = None,
     mesh: dict | None = None,
     mesh_since: dict | None = None,
+    gaps_since: dict | None = None,
 ) -> dict:
     """JSON-ready telemetry summary for a record: span totals (from a
     PhaseTimer), trace id + event count (from a Trace), recorder counters,
@@ -107,6 +114,15 @@ def telemetry_block(
     block["cost"] = (ledger if ledger is not None else get_ledger()).cost_block(
         since=ledger_since
     )
+    # dispatch-gap ledger: device busy vs idle over this record's window
+    # (``gaps_since`` = a GAPS.mark() taken at run start, mirroring
+    # ``ledger_since``), with idle intervals attributed to the host spans
+    # the run's trace (or the recorder ring) captured — spans off means
+    # honest unattributed idle, never a missing block
+    attribution_spans = spans_from_trace(trace) or spans_from_recorder(recorder)
+    block["gaps"] = get_gap_tracker().gaps_block(
+        since=gaps_since, spans=attribution_spans
+    )
     if mesh is not None and int(mesh.get("devices") or 1) > 1:
         block["mesh"] = mesh_block(
             mesh,
@@ -143,6 +159,14 @@ def validate_record(record: dict, kind: str = "record") -> dict:
             "every committed number"
         )
     validate_quality(telemetry["quality"], kind)
+    if "gaps" not in telemetry:
+        raise ValueError(
+            f"{kind} record's telemetry block is missing the 'gaps' "
+            "sub-block: assemble it with observability.records."
+            "telemetry_block so device busy/idle attribution (the overlap "
+            "ratio and its gap stages) travels with every committed number"
+        )
+    validate_gaps(telemetry["gaps"], kind)
     # multi-device records additionally carry the mesh block (per-device
     # roofline + HBM, balance ratio, collective classification): a record
     # whose own execution mode says it ran on >1 device without one is

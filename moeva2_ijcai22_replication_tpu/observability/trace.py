@@ -97,6 +97,14 @@ class TraceRecorder:
         """Seconds since the recorder epoch (monotonic)."""
         return self._clock() - self._t0
 
+    @property
+    def perf_epoch(self) -> float:
+        """The recorder's clock reading at epoch — what converts its
+        relative ``ts`` values to the dispatch-gap tracker's absolute
+        clock base (``observability.gaps`` joins gap intervals against
+        span events across the two)."""
+        return self._t0
+
     def emit(self, ev: dict) -> None:
         with self._lock:
             self._ring.append(ev)
@@ -109,7 +117,11 @@ class TraceRecorder:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
-    def gauge(self, name: str, value: float) -> None:
+    def gauge(self, name: str, value: float, at: float | None = None) -> None:
+        """``at`` (recorder-relative seconds) positions the emitted
+        counter sample at a specific timeline instant — how the dispatch-
+        gap tracker renders a device-busy counter track at the window's
+        true position instead of the emission instant."""
         with self._lock:
             self.gauges[name] = float(value)
         if self.spans_enabled:
@@ -118,7 +130,7 @@ class TraceRecorder:
                     "kind": "gauge",
                     "name": name,
                     "value": float(value),
-                    "ts": round(self.now(), 6),
+                    "ts": round(self.now() if at is None else max(at, 0.0), 6),
                 }
             )
 
@@ -219,11 +231,14 @@ class Trace:
             self._emit(ev)
 
     def record_span(
-        self, name: str, dur: float, parent=None, **attrs
+        self, name: str, dur: float, parent=None, at: float | None = None, **attrs
     ) -> int | None:
         """A span whose duration was measured elsewhere (e.g. under the
         batcher's injectable clock): recorded as ending now, ``dur`` seconds
-        long. Parent defaults to the calling thread's current span."""
+        long — or, with ``at`` (recorder-relative seconds), starting at
+        that exact timeline instant (how the dispatch-gap tracker places
+        ``device_gap`` slices where the idle actually happened). Parent
+        defaults to the calling thread's current span."""
         if not self.enabled:
             return None
         sid = next(_span_ids)
@@ -236,7 +251,7 @@ class Trace:
             "parent": parent if parent is not None else self._parent(),
             # clamped: a duration measured under a different clock (fake
             # batcher clocks in tests) must not produce a pre-epoch start
-            "ts": round(max(now - dur, 0.0), 6),
+            "ts": round(max(now - dur, 0.0) if at is None else max(at, 0.0), 6),
             "dur": round(dur, 6),
         }
         if attrs:

@@ -51,10 +51,13 @@ from ..observability import (
     current_ledger_context,
     current_trace,
     device_memory_stats,
+    get_coldstart,
+    get_gap_tracker,
     get_ledger,
     maybe_span,
     mesh_snapshot,
     sample_from_per_state,
+    spans_from_recorder,
 )
 from ..utils.config import get_dict_hash
 from ..utils.observability import ServiceMetrics
@@ -744,13 +747,29 @@ class AttackService:
             if entry is not None:
                 entry["mesh"] = res.execution["mesh"]
                 entry["resolved"] = True
+        # one cold-block assembly per poll: build.jax_cache references its
+        # persistent_cache section instead of re-scanning the cache dir
+        cold = get_coldstart().cold_block()
+        cache_keys = (
+            "dir", "enabled", "error",
+            "entries_start", "entries_now", "entries_added",
+        )
+        jax_cache = (
+            {k: cold["persistent_cache"].get(k) for k in cache_keys}
+            if cold.get("enabled")
+            else get_coldstart().cache_state()
+        )
         return {
             "ok": True,
             "uptime_s": round(time.time() - self._t0, 3),
             "domains": sorted(self.domains),
             "queue_depth_rows": self.batcher.queue_depth_rows(),
             "bucket_menu": list(self.menu.sizes),
-            "build": dict(self._build, meshes=meshes),
+            # jax_cache: the persistent-compilation-cache state (dir,
+            # enabled-vs-fallback, setup error) — a replica silently
+            # recompiling every program because its cache dir failed to
+            # mount shows here, not just in cold latency
+            "build": dict(self._build, meshes=meshes, jax_cache=jax_cache),
             # cost-ledger summary next to the build identity: executable
             # count, total compile seconds, executable-cache hit ratio —
             # a replica that recompiles on every request shows up here
@@ -771,6 +790,16 @@ class AttackService:
             # whose hot loop grew a collective (or whose devices skewed)
             # shows here before it shows in throughput
             "mesh": mesh_snapshot(),
+            # dispatch-gap view: device busy vs idle over the replica's
+            # lifetime, overlap ratio per producer/executable, and the
+            # host stages the idle attributes to — the replica-level
+            # answer to "is the device waiting on the host?"
+            "gaps": get_gap_tracker().snapshot(),
+            # replica warmup report: the startup-phase decomposition
+            # (import, artifact builds, lower-vs-compile split,
+            # per-executable persistent-cache hits/misses, time to first
+            # dispatch) — why THIS replica came up slow
+            "coldstart": cold,
             # shed/deadline attribution summary (full histograms stay on
             # /metrics): a replica shedding under backpressure vs losing
             # deadlines to device time reads differently here
@@ -820,6 +849,15 @@ class AttackService:
         # mesh view: device-labeled HBM/balance gauges and the collective
         # census under prom (observability.prom._mesh_lines)
         snap["mesh"] = mesh_snapshot()
+        # dispatch-gap view: lifetime totals (per-window wall basis —
+        # idle between requests is not a host stall) for the scalar
+        # gauges, plus the ring-scoped recent detail whose gap list is
+        # attributed against this service's recorded spans (spans off =>
+        # honestly unattributed)
+        snap["gaps"] = get_gap_tracker().snapshot(
+            spans=spans_from_recorder(self.recorder)
+        )
+        snap["coldstart"] = get_coldstart().cold_block()
         return snap
 
     def close(self):

@@ -167,9 +167,13 @@ def offered_load_sweep(
     # cost window: the record's telemetry.cost covers the sweep's own
     # dispatches (warmup compiles paid before this call stay out)
     ledger_mark = get_ledger().mark()
-    from ..observability import get_mesh_capture
+    from ..observability import get_gap_tracker, get_mesh_capture
 
     mesh_mark = get_mesh_capture().mark()
+    # dispatch-gap window, same discipline: the record's telemetry.gaps
+    # (overlap ratio + attributed gap stages) covers the sweep's own
+    # device timeline, not the warmup's
+    gaps_mark = get_gap_tracker().mark()
     # SLO window, same discipline: stage histograms and shed counts in
     # the record cover the sweep's traffic, not the warmup's
     slo_mark = service.slo.mark()
@@ -218,6 +222,7 @@ def offered_load_sweep(
             "telemetry": telemetry_block(
                 recorder=service.recorder,
                 ledger_since=ledger_mark,
+                gaps_since=gaps_mark,
                 mesh=mesh_desc,
                 mesh_since=mesh_mark,
                 quality=dict(

@@ -850,21 +850,21 @@ class TestBenchDiffRepoCheck:
     def test_committed_series_passes(self):
         """The repo check tier-1 runs: regressions in a future PR's bench
         record fail here. Committed records predate the ledger, so this
-        exercises the raw/shape fallback path too. ``--slo`` arms the
-        serving SLO gate (knee QPS + p99-at-fixed-load) alongside the
-        perf+quality watchdog — pre-SLO records skip as baselines, so the
-        gate goes live with the first record that carries
-        ``telemetry.slo`` and every later record is held to it; ``--mesh``
-        arms the balance-ratio + hot-loop-collective gate the same way
-        (goes live with the first multi-device record carrying
-        ``telemetry.mesh``)."""
+        exercises the raw/shape fallback path too. The flag list
+        (``--check --slo --mesh --overlap``) lives in ONE place now —
+        ``tools/repo_check.py`` — so this test drives the gate through
+        the consolidated entrypoint: SLO (knee QPS + p99-at-fixed-load),
+        mesh (balance ratio + hot-loop collectives), and overlap (device
+        overlap ratio + cold/steady ratio) all arm with the first record
+        carrying their telemetry block; pre-capture records skip as
+        baselines."""
         import glob as _glob
 
         series = sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json")))
         assert len(series) >= 2
         proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
-             "--check", "--slo", "--mesh", *series],
+            [sys.executable, os.path.join(REPO, "tools", "repo_check.py"),
+             "--only", "bench_diff", "--json"],
             capture_output=True,
             text=True,
             cwd=REPO,
@@ -872,6 +872,20 @@ class TestBenchDiffRepoCheck:
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "bench_diff: ok" in proc.stdout
+        assert "repo_check: ok" in proc.stdout
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload["ok"] is True
+        assert payload["gates"]["bench_diff"]["ok"] is True
+        # the consolidated gate must keep every watchdog armed: the
+        # bench_diff invocation it wraps carries all four flags
+        verdict = json.loads(
+            [
+                line
+                for line in proc.stdout.splitlines()
+                if line.startswith("{") and '"latest"' in line
+            ][-1]
+        )
+        assert verdict["slo"] and verdict["mesh"] and verdict["overlap"]
 
 
 # ---------------------------------------------------------------------------

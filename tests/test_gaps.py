@@ -1,0 +1,896 @@
+"""Device-utilization & cold-start observability: dispatch-gap ledger,
+overlap attribution, startup-phase decomposition, and the --overlap gate.
+
+Covers the PR-9 layer end to end, fixture-free (code-derived synthetic
+LCLD schema):
+
+- ``_window_intervals`` / ``join_gaps_to_spans`` pure units (fake
+  timelines, most-specific-span-wins attribution, the unattributed
+  bucket);
+- :class:`~moeva2_ijcai22_replication_tpu.observability.gaps.GapTracker`
+  under a fake clock: busy/idle/compile accounting, the compile-free
+  overlap ratio, ``mark()`` windows, inter-window seams, ring bounding;
+- the ``telemetry.gaps`` record schema (``telemetry_block`` always
+  carries it; ``validate_record`` rejects a record without it);
+- the cold-start ledger: phases, persistent-cache classification (hit /
+  miss_stored / disabled / fallback paths), the ``setup_jax_cache``
+  failure satellite (counted recorder event + surfaced error state);
+- engine integration: MoEvA and PGD runs land windows on the process
+  timeline at their existing sync points, emit Perfetto gap slices +
+  the device-busy counter track when traced — and the tier-1 smoke
+  pinning that gap/cold capture on/off is BIT-IDENTICAL with zero extra
+  compiles and zero extra dispatches;
+- Prometheus exposition of the gaps/coldstart families (HELP/TYPE on
+  every family, bounded label sets);
+- ``tools/bench_diff.py --overlap``: overlap-ratio drops and
+  cold/steady-ratio growth fail, pre-gap records skip as baselines,
+  lost capture fails, and the committed series stays green through the
+  consolidated ``tools/repo_check.py`` entrypoint.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.pgd import ConstrainedPGD
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import (
+    synth_lcld,
+    synth_lcld_schema,
+)
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+from moeva2_ijcai22_replication_tpu.observability import (
+    Trace,
+    TraceRecorder,
+    get_coldstart,
+    get_gap_tracker,
+    join_gaps_to_spans,
+    telemetry_block,
+    validate_cold,
+    validate_gaps,
+    validate_record,
+)
+from moeva2_ijcai22_replication_tpu.observability.coldstart import (
+    ColdStartLedger,
+)
+from moeva2_ijcai22_replication_tpu.observability.export import to_chrome_trace
+from moeva2_ijcai22_replication_tpu.observability.gaps import (
+    GapTracker,
+    _window_intervals,
+    emit_window_trace,
+    spans_from_trace,
+)
+from moeva2_ijcai22_replication_tpu.observability.prom import prometheus_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# pure units: interval model + gap↔span join
+# ---------------------------------------------------------------------------
+
+
+class TestWindowIntervals:
+    def test_single_dispatch_leading_and_trailing_gap(self):
+        busy, comp, gaps = _window_intervals(
+            0.0, 10.0, [(2.0, 5.0, 0.0)]
+        )
+        assert busy == [(2.0, 5.0)]
+        assert comp == []
+        assert gaps == [(0.0, 2.0), (7.0, 3.0)]
+
+    def test_compile_precedes_enqueue_instant(self):
+        """The enqueue timestamp is taken AFTER the compile returns, so
+        the compile interval sits immediately before it — charged as
+        compile, never as idle."""
+        busy, comp, gaps = _window_intervals(
+            0.0, 10.0, [(3.0, 4.0, 3.0)]
+        )
+        assert comp == [(0.0, 3.0)]
+        assert busy == [(3.0, 4.0)]
+        assert gaps == [(7.0, 3.0)]
+
+    def test_chained_dispatches_show_no_gap(self):
+        """Back-to-back async dispatches: the second was enqueued before
+        the first finished, so the device queue never drains — zero gap
+        between them (the serial-queue model)."""
+        busy, comp, gaps = _window_intervals(
+            0.0, 10.0, [(1.0, 4.0, 0.0), (2.0, 4.0, 0.0)]
+        )
+        assert busy == [(1.0, 4.0), (5.0, 4.0)]
+        assert gaps == [(0.0, 1.0), (9.0, 1.0)]
+
+    def test_host_stall_between_dispatches_is_a_gap(self):
+        busy, comp, gaps = _window_intervals(
+            0.0, 10.0, [(0.0, 2.0, 0.0), (6.0, 2.0, 0.0)]
+        )
+        assert (2.0, 4.0) in gaps
+
+    def test_runs_clamped_to_window(self):
+        busy, _, gaps = _window_intervals(0.0, 5.0, [(4.0, 10.0, 0.0)])
+        assert busy == [(4.0, 1.0)]
+        assert gaps == [(0.0, 4.0)]
+
+
+class TestJoinGapsToSpans:
+    def test_attributes_overlap_seconds_per_span(self):
+        out = join_gaps_to_spans(
+            [(2.0, 4.0)],
+            [{"name": "decode", "start": 3.0, "dur": 2.0}],
+        )
+        assert out["attributed"] == {"decode": 2.0}
+        assert out["unattributed_s"] == pytest.approx(2.0)
+        assert out["per_gap"][0]["top"] == "decode"
+
+    def test_most_specific_span_wins(self):
+        """A span tree's envelope (long) loses to its child (short) over
+        the instants the child covers — 'decode' beats the enclosing
+        'dispatch' exactly where decode ran."""
+        out = join_gaps_to_spans(
+            [(0.0, 10.0)],
+            [
+                {"name": "dispatch", "start": 0.0, "dur": 10.0},
+                {"name": "decode", "start": 4.0, "dur": 2.0},
+            ],
+        )
+        assert out["attributed"]["decode"] == pytest.approx(2.0)
+        assert out["attributed"]["dispatch"] == pytest.approx(8.0)
+        assert out["unattributed_s"] == 0.0
+
+    def test_no_spans_means_honest_unattributed(self):
+        out = join_gaps_to_spans([(0.0, 3.0)], [])
+        assert out["attributed"] == {}
+        assert out["unattributed_s"] == pytest.approx(3.0)
+        assert out["per_gap"][0]["top"] is None
+
+    def test_multiple_gaps_aggregate(self):
+        out = join_gaps_to_spans(
+            [(0.0, 1.0), (5.0, 1.0)],
+            [{"name": "fetch", "start": 0.0, "dur": 10.0}],
+        )
+        assert out["attributed"]["fetch"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# GapTracker under a fake clock
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tracker():
+    return GapTracker(clock=lambda: 0.0)
+
+
+class TestGapTracker:
+    def test_window_accounting(self, tracker):
+        w = tracker.record_window(
+            producer="pgd",
+            start=0.0,
+            end=10.0,
+            dispatches=[(3.0, 5.0, 3.0, "pgd_attack#1")],
+        )
+        assert w.busy_s == pytest.approx(5.0)
+        assert w.compile_s == pytest.approx(3.0)
+        # overlap ratio excludes compile from the wall: 5 busy over
+        # (10 - 3) active seconds, NOT over the raw 10
+        assert w.overlap_ratio() == pytest.approx(5.0 / 7.0)
+
+    def test_block_schema_and_ratio(self, tracker):
+        tracker.record_window(
+            producer="moeva",
+            start=0.0,
+            end=8.0,
+            dispatches=[(0.0, 6.0, 0.0, "moeva_segment#1")],
+        )
+        block = tracker.gaps_block()
+        validate_gaps(block)
+        assert block["windows"] == 1
+        assert block["overlap_ratio"] == pytest.approx(0.75)
+        assert block["idle_s"] == pytest.approx(2.0)
+        assert block["by_producer"]["moeva"]["overlap_ratio"] == pytest.approx(
+            0.75
+        )
+        assert block["by_executable"]["moeva_segment#1"]["busy_s"] == (
+            pytest.approx(6.0)
+        )
+
+    def test_inter_window_seam_counts_as_gap(self, tracker):
+        tracker.record_window(
+            producer="moeva", start=0.0, end=4.0,
+            dispatches=[(0.0, 4.0, 0.0, None)],
+        )
+        tracker.record_window(
+            producer="moeva", start=7.0, end=10.0,
+            dispatches=[(7.0, 3.0, 0.0, None)],
+        )
+        block = tracker.gaps_block(
+            spans=[{"name": "grid_write", "start": 4.0, "dur": 3.0}]
+        )
+        # busy 7 over wall 10 (no compile): the 3s seam between the two
+        # windows is idle, attributed to the writer span covering it
+        assert block["overlap_ratio"] == pytest.approx(0.7)
+        assert block["attributed"] == {"grid_write": 3.0}
+        assert block["top_gap_stages"][0][0] == "grid_write"
+
+    def test_mark_scopes_the_block(self, tracker):
+        tracker.record_window(
+            producer="pgd", start=0.0, end=5.0,
+            dispatches=[(0.0, 1.0, 0.0, None)],
+        )
+        mark = tracker.mark()
+        tracker.record_window(
+            producer="pgd", start=10.0, end=12.0,
+            dispatches=[(10.0, 2.0, 0.0, None)],
+        )
+        block = tracker.gaps_block(since=mark)
+        assert block["windows"] == 1
+        assert block["busy_s"] == pytest.approx(2.0)
+        assert block["overlap_ratio"] == pytest.approx(1.0)
+
+    def test_empty_window_scope(self, tracker):
+        mark = tracker.mark()
+        block = tracker.gaps_block(since=mark)
+        validate_gaps(block)
+        assert block["windows"] == 0 and block["overlap_ratio"] is None
+
+    def test_capture_off(self):
+        t = GapTracker(enabled=False)
+        assert (
+            t.record_window(
+                producer="pgd", start=0.0, end=1.0, dispatches=[]
+            )
+            is None
+        )
+        block = t.gaps_block()
+        assert block == {"enabled": False}
+        validate_gaps(block)  # enabled-off block stays schema-valid
+
+    def test_ring_bounded_but_totals_survive(self):
+        t = GapTracker(capacity=4, clock=lambda: 0.0)
+        for i in range(10):
+            t.record_window(
+                producer="pgd",
+                start=float(i),
+                end=float(i) + 1.0,
+                dispatches=[(float(i), 1.0, 0.0, None)],
+            )
+        assert t.gaps_block()["windows"] == 4  # ring keeps the last 4
+        snap = t.snapshot()
+        assert snap["totals"]["windows"] == 10  # totals never lose history
+        assert snap["totals"]["busy_s"] == pytest.approx(10.0)
+
+    def test_totals_keep_lifetime_by_producer_past_eviction(self):
+        """The ring-scoped block forgets evicted windows; the lifetime
+        totals (and their per-producer view) never do."""
+        t = GapTracker(capacity=2, clock=lambda: 0.0)
+        for i in range(5):
+            t.record_window(
+                producer="pgd",
+                start=2.0 * i,
+                end=2.0 * i + 1.0,
+                dispatches=[(2.0 * i, 0.5, 0.0, None)],
+            )
+        tot = t.totals()
+        assert tot["by_producer"]["pgd"]["windows"] == 5
+        assert tot["by_producer"]["pgd"]["overlap_ratio"] == pytest.approx(0.5)
+        assert t.gaps_block()["windows"] == 2  # ring kept only the last 2
+
+    def test_degenerate_window_ignored(self, tracker):
+        assert (
+            tracker.record_window(
+                producer="pgd", start=5.0, end=5.0, dispatches=[]
+            )
+            is None
+        )
+
+
+# ---------------------------------------------------------------------------
+# record schema: telemetry.gaps is load-bearing
+# ---------------------------------------------------------------------------
+
+
+class TestGapsSchema:
+    def test_telemetry_block_carries_gaps(self):
+        block = telemetry_block()
+        assert "gaps" in block
+        validate_gaps(block["gaps"])
+        rec = {"execution": {}, "telemetry": block}
+        assert validate_record(rec) is rec
+
+    def test_validate_record_rejects_missing_gaps(self):
+        block = telemetry_block()
+        block.pop("gaps")
+        with pytest.raises(ValueError, match="gaps"):
+            validate_record({"execution": {}, "telemetry": block}, "bench")
+
+    def test_validate_gaps_rejects_partial_block(self):
+        with pytest.raises(ValueError, match="missing"):
+            validate_gaps({"windows": 1})
+        with pytest.raises(ValueError, match="dict"):
+            validate_gaps("nope")
+
+    def test_spans_from_trace_excludes_own_gap_slices(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec, trace_id="x")
+        t.record_span("decode", 0.5)
+        t.record_span("device_gap", 0.5)
+        names = {s["name"] for s in spans_from_trace(t)}
+        assert names == {"decode"}
+
+    def test_record_span_at_positions_the_slice(self):
+        rec = TraceRecorder(spans_enabled=True)
+        t = Trace(rec, trace_id="x")
+        t.record_span("device_gap", 2.0, at=7.25)
+        ev = [e for e in t.events if e["kind"] == "span"][0]
+        assert ev["ts"] == pytest.approx(7.25)
+        assert ev["dur"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# cold-start ledger
+# ---------------------------------------------------------------------------
+
+
+class TestColdStart:
+    def test_phases_accumulate(self):
+        cs = ColdStartLedger()
+        cs.record_phase("artifact_build", 0.5)
+        cs.record_phase("artifact_build", 0.25)
+        with cs.phase("device_warmup"):
+            pass
+        block = cs.cold_block()
+        validate_cold(block)
+        assert block["phases"]["artifact_build"] == pytest.approx(0.75)
+        assert block["phase_counts"]["artifact_build"] == 2
+        assert "device_warmup" in block["phases"]
+
+    def test_import_noted_once(self):
+        cs = ColdStartLedger()
+        cs.note_import_complete()
+        first = cs.cold_block()["phases"]["import"]
+        cs.note_import_complete()
+        assert cs.cold_block()["phases"]["import"] == first
+
+    def test_cache_disabled_classification(self):
+        cs = ColdStartLedger()
+        cs.configure_cache(None, False)
+        out = cs.note_compile(
+            producer="pgd_attack", key="pgd_attack#1",
+            lower_s=0.1, compile_s=0.4, probe=cs.compile_probe(),
+        )
+        assert out == "disabled"
+        block = cs.cold_block()
+        assert block["phases"]["trace_lower"] == pytest.approx(0.1)
+        assert block["phases"]["xla_compile"] == pytest.approx(0.4)
+        pc = block["persistent_cache"]
+        assert pc["by_outcome"] == {"disabled": 1}
+        assert pc["by_executable"][0]["key"] == "pgd_attack#1"
+
+    def test_miss_stored_via_cache_dir_diff(self, tmp_path):
+        cs = ColdStartLedger()
+        cs._listener_registered = False  # force the dir-diff path
+        cs.configure_cache(str(tmp_path), True)
+        probe = cs.compile_probe()
+        (tmp_path / "entry0.bin").write_bytes(b"x")  # jax stored an entry
+        out = cs.note_compile(
+            producer="moeva_segment", key="moeva_segment#1",
+            lower_s=0.1, compile_s=2.0, probe=probe,
+        )
+        assert out == "miss_stored"
+        state = cs.cache_state()
+        assert state["entries_start"] == 0 and state["entries_added"] == 1
+
+    def test_hit_via_monitoring_counter(self, tmp_path):
+        cs = ColdStartLedger()
+        cs.configure_cache(str(tmp_path), True)
+        cs._listener_registered = True  # monitoring available
+        probe = cs.compile_probe()
+        cs._jax_hits += 1  # jax fired /jax/compilation_cache/cache_hits
+        out = cs.note_compile(
+            producer="pgd_attack", key="pgd_attack#2",
+            lower_s=0.05, compile_s=0.2, probe=probe,
+        )
+        assert out == "hit"
+        assert cs.cold_block()["persistent_cache"]["hits"] == 1
+
+    def test_miss_uncached_via_monitoring_counter(self, tmp_path):
+        cs = ColdStartLedger()
+        cs.configure_cache(str(tmp_path), True)
+        cs._listener_registered = True
+        probe = cs.compile_probe()
+        cs._jax_misses += 1
+        out = cs.note_compile(
+            producer="pgd_attack", key="pgd_attack#3",
+            lower_s=0.05, compile_s=0.2, probe=probe,
+        )
+        assert out == "miss_uncached"
+
+    def test_fallback_outcome(self):
+        cs = ColdStartLedger()
+        out = cs.note_compile(
+            producer="pgd_attack", key=None, lower_s=0.3, compile_s=0.0,
+            aot=False,
+        )
+        assert out == "fallback"
+
+    def test_capture_off_is_inert(self):
+        cs = ColdStartLedger(enabled=False)
+        cs.record_phase("import", 1.0)
+        assert cs.note_compile(
+            producer="p", key=None, lower_s=0.1, compile_s=0.1
+        ) == "off"
+        block = cs.cold_block()
+        assert block == {"enabled": False}
+        validate_cold(block)
+
+    def test_setup_jax_cache_failure_is_counted_and_surfaced(
+        self, monkeypatch, tmp_path
+    ):
+        """The satellite: a swallowed persistent-cache failure must leave
+        a counted recorder event and structured error state, not just a
+        bare print."""
+        import jax
+
+        from moeva2_ijcai22_replication_tpu.experiments.common import (
+            setup_jax_cache,
+        )
+        from moeva2_ijcai22_replication_tpu.observability.trace import (
+            default_recorder,
+        )
+
+        cs = get_coldstart()
+        before_err = cs.cache_error
+        before_count = default_recorder().counters.get(
+            "jax_cache_setup_failures", 0
+        )
+
+        def boom(name, value):
+            raise RuntimeError("no cache for you")
+
+        monkeypatch.setattr(jax.config, "update", boom)
+        try:
+            setup_jax_cache(
+                {"system": {"jax_cache_dir": str(tmp_path / "jc")}}
+            )
+            assert (
+                default_recorder().counters["jax_cache_setup_failures"]
+                == before_count + 1
+            )
+            state = cs.cache_state()
+            assert state["enabled"] is False
+            assert "no cache for you" in state["error"]
+        finally:
+            monkeypatch.undo()
+            cs.cache_dir = None
+            cs.cache_enabled = None
+            cs.cache_error = before_err
+            cs.cache_entries_start = None
+
+
+# ---------------------------------------------------------------------------
+# engine integration (synthetic problem, tiny shapes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def problem(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("gaps")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(12, cons.schema, seed=3)
+    cons.check_constraints_error(x)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=7))
+    return {
+        "constraints": cons,
+        "surrogate": sur,
+        "scaler": fit_minmax(x.min(0), x.max(0)),
+        "x": x,
+    }
+
+
+def _engine(problem, **kw):
+    kw.setdefault("n_gen", 11)
+    kw.setdefault("n_pop", 16)
+    kw.setdefault("n_offsprings", 8)
+    kw.setdefault("seed", 5)
+    kw.setdefault("archive_size", 4)
+    return Moeva2(
+        classifier=problem["surrogate"],
+        constraints=problem["constraints"],
+        ml_scaler=problem["scaler"],
+        norm=2,
+        **kw,
+    )
+
+
+class TestEngineGapCapture:
+    def test_moeva_generate_lands_a_window(self, problem):
+        tracker = get_gap_tracker()
+        mark = tracker.mark()
+        eng = _engine(problem)
+        eng.generate(problem["x"], 1)
+        block = tracker.gaps_block(since=mark)
+        assert block["windows"] == 1
+        assert block["by_producer"].keys() == {"moeva"}
+        assert block["overlap_ratio"] is not None
+        assert 0.0 < block["overlap_ratio"] <= 1.0
+        # the window names the executables it dispatched (ledger keys)
+        assert any(
+            k.startswith(("moeva_init", "moeva_segment"))
+            for k in block["by_executable"]
+        )
+
+    def test_warm_run_has_zero_compile_in_window(self, problem):
+        tracker = get_gap_tracker()
+        eng = _engine(problem, seed=6)
+        eng.generate(problem["x"], 1)  # cold
+        mark = tracker.mark()
+        eng.generate(problem["x"], 1)  # warm
+        block = tracker.gaps_block(since=mark)
+        assert block["windows"] == 1
+        assert block["compile_s"] == pytest.approx(0.0)
+
+    def test_pgd_generate_lands_a_window(self, problem):
+        tracker = get_gap_tracker()
+        mark = tracker.mark()
+        pgd = ConstrainedPGD(
+            classifier=problem["surrogate"],
+            constraints=problem["constraints"],
+            scaler=problem["scaler"],
+            max_iter=4,
+        )
+        xs = np.asarray(problem["scaler"].transform(problem["x"]))
+        y = np.asarray(
+            problem["surrogate"].predict_proba(xs)
+        ).argmax(-1)
+        pgd.generate(xs, y)
+        block = tracker.gaps_block(since=mark)
+        assert block["windows"] == 1
+        assert "pgd" in block["by_producer"]
+
+    def test_traced_run_emits_gap_slices_and_busy_counter(self, problem):
+        rec = TraceRecorder(spans_enabled=True)
+        eng = _engine(problem, seed=7, record_quality=True, quality_every=5)
+        eng.trace = Trace(rec, trace_id="gaps-test")
+        eng.generate(problem["x"], 1)
+        gauges = [
+            e
+            for e in rec.events()
+            if e.get("kind") == "gauge" and e["name"] == "device_busy_ratio"
+        ]
+        assert gauges, "device-busy counter sample missing"
+        doc = to_chrome_trace(rec.events())
+        counters = [
+            e for e in doc["traceEvents"] if e.get("ph") == "C"
+        ]
+        assert any(e["name"] == "device_busy_ratio" for e in counters)
+        # gap slices render as X spans named device_gap (placement is the
+        # true timeline instant, not the emission instant)
+        slices = [
+            e
+            for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e.get("name") == "device_gap"
+        ]
+        assert slices
+        assert all(e["args"].get("producer") == "moeva" for e in slices)
+
+    def test_emit_window_trace_noop_when_untraced(self, tracker=None):
+        w = GapTracker(clock=lambda: 0.0).record_window(
+            producer="pgd", start=0.0, end=1.0,
+            dispatches=[(0.0, 1.0, 0.0, None)],
+        )
+        emit_window_trace(None, w)  # must not raise
+        emit_window_trace(Trace(TraceRecorder(), enabled=False), w)
+
+
+class TestCaptureToggleSmoke:
+    def test_gap_and_cold_capture_toggle_is_bit_identical_zero_overhead(
+        self, problem
+    ):
+        """The tier-1 contract every observability PR keeps: capture
+        on/off shares every compile and every dispatch, and the attack
+        results are bit-identical."""
+        tracker = get_gap_tracker()
+        coldstart = get_coldstart()
+        x = problem["x"]
+
+        def run(enabled):
+            prev_t, prev_c = tracker.enabled, coldstart.enabled
+            tracker.enabled = enabled
+            coldstart.enabled = enabled
+            try:
+                eng = _engine(problem, seed=11)
+                res = eng.generate(x, 1)
+                calls = eng._jit_init.calls + eng._jit_segment.calls
+                compiles = len(eng._jit_init._compiled) + len(
+                    eng._jit_segment._compiled
+                )
+            finally:
+                tracker.enabled = prev_t
+                coldstart.enabled = prev_c
+            return res, calls, compiles
+
+        res_on, calls_on, compiles_on = run(True)
+        res_off, calls_off, compiles_off = run(False)
+        assert calls_on == calls_off
+        assert compiles_on == compiles_off
+        np.testing.assert_array_equal(res_on.x_gen, res_off.x_gen)
+        np.testing.assert_array_equal(res_on.f, res_off.f)
+        np.testing.assert_array_equal(res_on.x_ml, res_off.x_ml)
+
+    def test_capture_off_records_nothing(self, problem):
+        tracker = get_gap_tracker()
+        mark = tracker.mark()
+        prev = tracker.enabled
+        tracker.enabled = False
+        try:
+            eng = _engine(problem, seed=12)
+            eng.generate(problem["x"], 1)
+        finally:
+            tracker.enabled = prev
+        assert tracker.gaps_block(since=mark)["windows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+class TestPromExposition:
+    def _snapshot(self):
+        t = GapTracker(clock=lambda: 0.0)
+        t.record_window(
+            producer="moeva", start=0.0, end=10.0,
+            dispatches=[(1.0, 6.0, 1.0, "moeva_segment#1")],
+        )
+        cs = ColdStartLedger()
+        cs.configure_cache(None, False)
+        cs.record_phase("artifact_build", 0.4)
+        cs.note_compile(
+            producer="moeva_segment", key="moeva_segment#1",
+            lower_s=0.2, compile_s=0.8, probe={},
+        )
+        return {
+            "counters": {},
+            "gauges": {},
+            "streams": {},
+            "gaps": t.gaps_block(
+                spans=[{"name": "decode", "start": 8.0, "dur": 2.0}]
+            ),
+            "coldstart": cs.cold_block(),
+        }
+
+    def test_families_have_help_and_type(self):
+        text = prometheus_text(self._snapshot())
+        for family in (
+            "moeva2_overlap_ratio",
+            "moeva2_device_busy_s",
+            "moeva2_device_idle_s",
+            "moeva2_gap_attributed_s",
+            "moeva2_producer_overlap_ratio",
+            "moeva2_coldstart_phase_s",
+        ):
+            assert f"# HELP {family}" in text, family
+            assert f"# TYPE {family}" in text, family
+
+    def test_gap_values_and_labels(self):
+        text = prometheus_text(self._snapshot())
+        # busy 6 over active wall (10 - 1 compile) = 9
+        assert "moeva2_overlap_ratio 0.6667" in text
+        assert 'moeva2_gap_attributed_s{stage="decode"} 2' in text
+        assert 'moeva2_producer_overlap_ratio{producer="moeva"}' in text
+        assert 'moeva2_coldstart_phase_s{phase="artifact_build"} 0.4' in text
+
+    def test_capture_off_emits_no_gap_families(self):
+        text = prometheus_text(
+            {
+                "counters": {},
+                "gauges": {},
+                "streams": {},
+                "gaps": {"enabled": False},
+                "coldstart": {"enabled": False},
+            }
+        )
+        assert "overlap_ratio" not in text
+        assert "coldstart" not in text
+
+
+# ---------------------------------------------------------------------------
+# bench_diff --overlap + repo_check
+# ---------------------------------------------------------------------------
+
+
+def _orecord(steady=10.0, overlap=0.9, cold_ratio=1.2, with_gaps=True):
+    rec = {
+        "metric": "m",
+        "value": 80.0,
+        "steady_s": steady,
+        "cold_s": steady * cold_ratio,
+        "execution": {"n_states": 1000, "n_gen": 1000},
+        "telemetry": {
+            "cost": {"flops_total": 1e12},
+            "quality": {"enabled": False},
+        },
+    }
+    if with_gaps:
+        rec["telemetry"]["gaps"] = {
+            "enabled": True,
+            "windows": 3,
+            "busy_s": overlap * 10.0,
+            "overlap_ratio": overlap,
+            "attributed": {},
+        }
+        rec["cold_steady_ratio"] = cold_ratio
+        rec["cold"] = {
+            "enabled": True,
+            "phases": {"xla_compile": 2.0},
+            "persistent_cache": {"hits": 4, "misses": 2},
+            "time_to_first_dispatch_s": 3.0,
+        }
+    return rec
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+class TestBenchDiffOverlap:
+    @pytest.fixture()
+    def bench_diff(self):
+        return _load_tool("bench_diff")
+
+    def test_overlap_drop_fails(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord(overlap=0.9))
+        b = _write(tmp_path, "b.json", _orecord(overlap=0.5))
+        assert bench_diff.main([a, b, "--overlap"]) == 1
+        # without the flag the gate stays unarmed (opt-in like --slo)
+        assert bench_diff.main([a, b]) == 0
+
+    def test_small_overlap_jitter_passes(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord(overlap=0.90))
+        b = _write(tmp_path, "b.json", _orecord(overlap=0.80))
+        assert bench_diff.main([a, b, "--overlap"]) == 0
+
+    def test_cold_ratio_growth_fails(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord(cold_ratio=1.2))
+        b = _write(tmp_path, "b.json", _orecord(cold_ratio=2.4))
+        assert bench_diff.main([a, b, "--overlap"]) == 1
+
+    def test_cold_ratio_improvement_passes(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord(cold_ratio=2.4))
+        b = _write(tmp_path, "b.json", _orecord(cold_ratio=1.1))
+        assert bench_diff.main([a, b, "--overlap"]) == 0
+
+    def test_pre_gap_baselines_skip(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord(with_gaps=False))
+        b = _write(tmp_path, "b.json", _orecord(overlap=0.4, cold_ratio=3.0))
+        # first record carrying the blocks arms the gate without failing
+        assert bench_diff.main([a, b, "--overlap"]) == 0
+
+    def test_lost_capture_fails(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord())
+        b = _write(tmp_path, "b.json", _orecord(with_gaps=False))
+        assert bench_diff.main([a, b, "--overlap"]) == 1
+        # the loss is invisible without the flag (committed series
+        # compatibility) — arming is what makes it non-disarmable
+        assert bench_diff.main([a, b]) == 0
+
+    def test_bare_cold_s_without_breakdown_is_not_capture(
+        self, bench_diff, tmp_path
+    ):
+        """cold_s/steady_s existed since r01: only the structured cold
+        breakdown arms the cold gate, so pre-PR records stay baselines."""
+        a = _write(tmp_path, "a.json", _orecord(with_gaps=False))
+        assert bench_diff._overlap_points(json.loads(open(a).read())) == {}
+
+    def test_threshold_configurable(self, bench_diff, tmp_path):
+        a = _write(tmp_path, "a.json", _orecord(overlap=0.9))
+        b = _write(tmp_path, "b.json", _orecord(overlap=0.75))
+        assert bench_diff.main([a, b, "--overlap"]) == 0
+        assert (
+            bench_diff.main(
+                [a, b, "--overlap", "--overlap-threshold", "0.1"]
+            )
+            == 1
+        )
+
+    def test_json_line_carries_overlap_verdicts(
+        self, bench_diff, tmp_path, capsys
+    ):
+        a = _write(tmp_path, "a.json", _orecord(overlap=0.9))
+        b = _write(tmp_path, "b.json", _orecord(overlap=0.4))
+        rc = bench_diff.main([a, b, "--overlap", "--json"])
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 1 and doc["regressed"] and doc["overlap"] is True
+        by_metric = {m["metric"]: m for m in doc["metrics"]}
+        assert by_metric["gaps.overlap_ratio"]["verdict"] == "regression"
+
+    def test_committed_series_green_with_first_gap_record(
+        self, bench_diff, tmp_path
+    ):
+        """The repo check's exact semantics: the committed pre-gap series
+        plus a first gap/cold-bearing record passes — the gate arms from
+        that record forward."""
+        import glob as _glob
+        import shutil
+
+        for p in sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+            shutil.copy(p, tmp_path / os.path.basename(p))
+        rec = _orecord(steady=9.0, overlap=0.85, cold_ratio=1.15)
+        nxt = _write(
+            tmp_path, "BENCH_r99.json", {"n": 99, "rc": 0, "parsed": rec}
+        )
+        series = sorted(str(p) for p in tmp_path.glob("BENCH_r*.json"))
+        assert nxt in series
+        assert (
+            bench_diff.main(["--check", "--slo", "--mesh", "--overlap", *series])
+            == 0
+        )
+
+
+class TestRepoCheckEntrypoint:
+    def test_failing_gate_propagates_and_summary_names_it(self, tmp_path):
+        """A regressing series fails the consolidated entrypoint with a
+        per-gate FAIL line — the injected-regression evidence the
+        acceptance criteria require, through the same command tier-1
+        runs."""
+        _write(tmp_path, "BENCH_r01.json", _orecord(overlap=0.9))
+        _write(tmp_path, "BENCH_r02.json", _orecord(overlap=0.3))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "repo_check.py"),
+                "--only",
+                "bench_diff",
+                "--cwd",
+                str(tmp_path),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "bench_diff   FAIL" in proc.stdout
+        assert "repo_check: FAILING" in proc.stdout
+        assert "gaps.overlap_ratio" in proc.stdout
+
+    def test_green_series_passes(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", _orecord(overlap=0.85))
+        _write(tmp_path, "BENCH_r02.json", _orecord(overlap=0.9))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "repo_check.py"),
+                "--only",
+                "bench_diff",
+                "--json",
+                "--cwd",
+                str(tmp_path),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["ok"] is True

@@ -516,21 +516,32 @@ class TestLintInjected:
 
 class TestShardLintRepoCheck:
     def test_committed_domains_lint_green_and_selftest_trips(self):
-        """The repo check tier-1 runs (wired next to ``bench_diff --check
-        --slo --mesh``): the committed attack programs must compile clean
-        on the emulated 8-device mesh — zero hot-loop data collectives,
-        no implicit transfers, no unintended replication — and the
-        selftest proves the lint still trips on injected violations."""
+        """The repo check tier-1 runs, through the consolidated
+        ``tools/repo_check.py`` entrypoint (one flag list for every call
+        site): the committed attack programs must compile clean on the
+        emulated 8-device mesh — zero hot-loop data collectives, no
+        implicit transfers, no unintended replication — and the selftest
+        proves the lint still trips on injected violations."""
         proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "shard_lint.py"),
-             "--check", "--selftest", "--json"],
+            [sys.executable, os.path.join(REPO, "tools", "repo_check.py"),
+             "--only", "shard_lint", "--selftest", "--json"],
             capture_output=True,
             text=True,
             cwd=REPO,
-            timeout=540,
+            timeout=560,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
-        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert "repo_check: ok" in proc.stdout
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["ok"] is True
+        assert summary["gates"]["shard_lint"]["ok"] is True
+        payload = json.loads(
+            [
+                line
+                for line in proc.stdout.splitlines()
+                if line.startswith("{") and '"linted"' in line
+            ][-1]
+        )
         assert payload["ok"] is True
         assert payload["violations"] == []
         assert "lcld_synth" in payload["linted"]

@@ -354,9 +354,22 @@ class TestRecordSchema:
                 {"execution": {}, "telemetry": {"cost": {}, "quality": {}}},
                 "bench",
             )
+        # PR-9 dispatch-gap ledger: and the gaps sub-block
+        with pytest.raises(ValueError, match="gaps"):
+            validate_record(
+                {
+                    "execution": {},
+                    "telemetry": {"cost": {}, "quality": quality_block()},
+                },
+                "bench",
+            )
         rec = {
             "execution": {},
-            "telemetry": {"cost": {}, "quality": quality_block()},
+            "telemetry": {
+                "cost": {},
+                "quality": quality_block(),
+                "gaps": {"enabled": False},
+            },
         }
         assert validate_record(rec) is rec
         assert set(REQUIRED_RECORD_KEYS) == {"execution", "telemetry"}

@@ -70,6 +70,19 @@ there is no tolerance to tune. Baseline-skip semantics match ``--slo``:
 pre-mesh (or single-device) records skip as baselines, but a latest
 record that LOST mesh capture while any baseline carries it fails.
 
+Overlap/cold metrics (``--overlap``): records carrying a
+``telemetry.gaps`` block expose the device overlap ratio (device-busy
+seconds over compile-free wall — 1.0 means the host never left the
+device idle), and records carrying the structured ``cold`` breakdown
+expose ``cold_steady_ratio`` (cold wall over steady wall — ROADMAP item
+2's exit criterion is <= 1.2). With ``--overlap``, an overlap-ratio drop
+past ``--overlap-threshold`` (relative, default 0.25) or a
+cold/steady-ratio GROWTH past the same threshold fails the check.
+Baseline-skip semantics match ``--slo``/``--mesh``: pre-gap records
+(r01–r05) skip as baselines, but a latest record that LOST gap or cold
+capture while any baseline carries it fails — the gate must not be
+disarmable by dropping the measurement.
+
 Records may be bare bench JSON or the committed driver wrapper
 ``{"n", "cmd", "rc", "parsed"}``; wrappers with a non-zero rc or an
 empty payload are skipped (a crashed bench is not evidence of a
@@ -102,6 +115,12 @@ DEFAULT_SLO_THRESHOLD = 0.5
 #: only from early-exit timing jitter reshuffling which devices park
 #: first, well inside 25% at the committed shapes.
 DEFAULT_MESH_THRESHOLD = 0.25
+
+#: relative overlap-ratio drop (or cold/steady-ratio growth) that fails
+#: the check under --overlap. Both ratios are wall-clock quotients on a
+#: tunnelled host with ~±10% jitter on each side, so the gate sits at
+#: the same 2.5x-noise-floor margin as the perf threshold.
+DEFAULT_OVERLAP_THRESHOLD = 0.25
 
 #: o-columns tracked at each interior budget: o2 (misclassified) and o7
 #: (the full constrained-adversarial criterion) — the two the round-5
@@ -332,6 +351,36 @@ def _mesh_points(rec: dict) -> dict[str, float]:
     return out
 
 
+def _overlap_points(rec: dict) -> dict[str, tuple[float, bool]]:
+    """Every overlap/cold metric this record exposes:
+    ``{name: (value, lower_is_better)}``. Overlap ratio keys off a
+    capture-on ``telemetry.gaps`` block with a numeric ratio;
+    cold/steady keys off the structured ``cold`` breakdown sitting next
+    to ``cold_steady_ratio`` — presence of the decomposition IS the
+    capture marker (a bare cold_s/steady_s pair predates the gate and
+    skips as a baseline)."""
+    out: dict[str, tuple[float, bool]] = {}
+    gaps = _get(rec, "telemetry.gaps")
+    # overlap gates HEADLINE records only (steady_s present = the
+    # contiguous batch run): a serving-only record's telemetry.gaps wall
+    # spans the sweep's request PACING, so its ratio tracks offered load,
+    # not host stalls — gating it would fail on a reshaped load ladder
+    if (
+        isinstance(gaps, dict)
+        and gaps.get("enabled") is not False
+        and isinstance(rec.get("steady_s"), (int, float))
+    ):
+        ratio = gaps.get("overlap_ratio")
+        if isinstance(ratio, (int, float)):
+            out["gaps.overlap_ratio"] = (float(ratio), False)
+    cold = rec.get("cold")
+    if isinstance(cold, dict) and cold.get("enabled") is not False:
+        csr = rec.get("cold_steady_ratio")
+        if isinstance(csr, (int, float)):
+            out["cold_steady_ratio"] = (float(csr), True)
+    return out
+
+
 def diff_series(
     records: list[tuple[str, dict]],
     threshold: float,
@@ -340,6 +389,8 @@ def diff_series(
     slo_threshold: float = DEFAULT_SLO_THRESHOLD,
     mesh: bool = False,
     mesh_threshold: float = DEFAULT_MESH_THRESHOLD,
+    overlap: bool = False,
+    overlap_threshold: float = DEFAULT_OVERLAP_THRESHOLD,
 ) -> tuple[list[str], bool, list[dict]]:
     """Compare the last record pairwise against every earlier one, each
     pair in the strongest normalization basis BOTH sides support (ledger
@@ -701,6 +752,109 @@ def diff_series(
                     "verdict": "regression" if bad else "ok",
                 }
             )
+    # -- overlap/cold: device utilization + cold start, opt-in ------------
+    if overlap:
+        new_ov = _overlap_points(latest)
+        old_ov: dict[str, list[tuple[str, float]]] = {}
+        any_baseline_ov = False
+        for path, rec in earlier:
+            pts = _overlap_points(rec)
+            any_baseline_ov |= bool(pts)
+            for name, (v, _) in pts.items():
+                old_ov.setdefault(name, []).append((path, v))
+        if not any_baseline_ov and not new_ov:
+            lines.append(
+                f"  overlap: no telemetry.gaps/cold metrics in "
+                f"{latest_path} or any baseline — skipped"
+            )
+            entries.append(
+                {"metric": "overlap", "verdict": "skipped", "reason": "absent"}
+            )
+        elif any_baseline_ov and not new_ov:
+            # block-level capture loss: a baseline measured its overlap
+            # ratio / cold decomposition, the latest record measured
+            # nothing — the gate must not be disarmable by dropping the
+            # measurement (quality/slo/mesh discipline)
+            regressed = True
+            lines.append(
+                f"  overlap: baselines carry telemetry.gaps/cold but "
+                f"{latest_path} does not — gap/cold capture was lost  "
+                "** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": "overlap",
+                    "kind": "overlap",
+                    "verdict": "regression",
+                    "reason": "overlap_capture_lost",
+                }
+            )
+        # per-metric capture loss (e.g. the latest record kept its gaps
+        # block but dropped the cold breakdown): same non-disarmable rule
+        for name in sorted(set(old_ov) - set(new_ov)):
+            if not new_ov and any_baseline_ov:
+                break  # already failed block-level above
+            regressed = True
+            path = old_ov[name][0][0]
+            lines.append(
+                f"  {name}: present in {path} but ABSENT in {latest_path} "
+                "— overlap/cold capture was lost  ** REGRESSION **"
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "overlap",
+                    "baseline": path,
+                    "verdict": "regression",
+                    "reason": "overlap_capture_lost",
+                }
+            )
+        for name in sorted(new_ov):
+            new_v, lower_better = new_ov[name]
+            olds = old_ov.get(name, [])
+            if not olds:
+                lines.append(
+                    f"  {name}: no comparable earlier record — skipped"
+                )
+                entries.append(
+                    {"metric": name, "verdict": "skipped",
+                     "reason": "no_baseline"}
+                )
+                continue
+            pairs = [
+                (
+                    (new_v - old_v) / old_v
+                    if lower_better
+                    else (old_v - new_v) / old_v,
+                    path,
+                    old_v,
+                )
+                for path, old_v in olds
+                if old_v != 0
+            ]
+            if not pairs:
+                continue
+            rel, path, old_v = max(pairs, key=lambda t: t[0])
+            bad = rel > overlap_threshold
+            regressed |= bad
+            direction = "worse" if rel > 0 else "better"
+            lines.append(
+                f"  {name}: {new_v:.6g} vs best {old_v:.6g} ({path}) "
+                f"[overlap] -> {abs(rel) * 100:.1f}% {direction}"
+                + ("  ** REGRESSION **" if bad else "")
+            )
+            entries.append(
+                {
+                    "metric": name,
+                    "kind": "overlap",
+                    "basis": "relative",
+                    "baseline": path,
+                    "old": old_v,
+                    "new": new_v,
+                    "delta_rel": rel,
+                    "verdict": "regression" if bad else "ok",
+                }
+            )
     return lines, regressed, entries
 
 
@@ -763,6 +917,22 @@ def main(argv=None) -> int:
         f"(default {DEFAULT_MESH_THRESHOLD})",
     )
     parser.add_argument(
+        "--overlap",
+        action="store_true",
+        help="also gate the device-utilization metrics: the overlap ratio "
+        "(telemetry.gaps device-busy/wall; relative drop fails) and the "
+        "cold/steady ratio (records carrying the structured cold "
+        "breakdown; relative growth fails). Pre-gap records skip as "
+        "baselines; a latest record that LOST gap/cold capture fails",
+    )
+    parser.add_argument(
+        "--overlap-threshold",
+        type=float,
+        default=DEFAULT_OVERLAP_THRESHOLD,
+        help="relative overlap/cold regression that fails under --overlap "
+        f"(default {DEFAULT_OVERLAP_THRESHOLD})",
+    )
+    parser.add_argument(
         "--json",
         action="store_true",
         help="append one machine-readable JSON line (per-metric basis, "
@@ -813,6 +983,8 @@ def main(argv=None) -> int:
         slo_threshold=args.slo_threshold,
         mesh=args.mesh,
         mesh_threshold=args.mesh_threshold,
+        overlap=args.overlap,
+        overlap_threshold=args.overlap_threshold,
     )
     print("\n".join(lines))
     if regressed:
@@ -833,6 +1005,8 @@ def main(argv=None) -> int:
                     "slo_threshold": args.slo_threshold,
                     "mesh": args.mesh,
                     "mesh_threshold": args.mesh_threshold,
+                    "overlap": args.overlap,
+                    "overlap_threshold": args.overlap_threshold,
                     "regressed": regressed,
                     "metrics": entries,
                 }

@@ -59,6 +59,39 @@ def compile_seconds(d):
     return f"{v:.1f}" if isinstance(v, (int, float)) else "—"
 
 
+def overlap_ratio(d):
+    """Device overlap ratio of this run (``telemetry.gaps``: device-busy
+    seconds over compile-free wall, 1.0 = the host never left the device
+    idle); ``—`` for runs that predate the dispatch-gap ledger or whose
+    capture was off."""
+    gaps = (d.get("telemetry") or {}).get("gaps") or {}
+    v = gaps.get("overlap_ratio")
+    return f"{v:.2f}" if isinstance(v, (int, float)) else "—"
+
+
+def cold_ratio(d):
+    """This run's cold multiplier: compile-inclusive wall over run-only
+    wall (``time / (time - compile_s)``) — the per-run proxy for the
+    cold/steady ratio the bench record gates; ``—`` on warm runs (no
+    compile seconds) and pre-attribution metrics."""
+    ledger = (d.get("telemetry") or {}).get("cost") or {}
+    compile_s = ledger.get("compile_s_total")
+    if not isinstance(compile_s, (int, float)) or compile_s <= 0:
+        compile_s = d.get("timings", {}).get("attack_compile")
+        if isinstance(compile_s, (int, float)):
+            # the attack_compile span is the whole cold attack wall, not
+            # the compile alone — no honest ratio derivable from it
+            return "—"
+    t = d.get("time")
+    if (
+        not isinstance(compile_s, (int, float))
+        or not isinstance(t, (int, float))
+        or t <= compile_s
+    ):
+        return "—"
+    return f"{t / (t - compile_s):.2f}x"
+
+
 def interior_rate(d, budget):
     """Engine-judged interior o2/o7 at ``budget`` generation steps from the
     metrics' ``telemetry.quality.interior`` block (post-PR-6 runs with
@@ -88,6 +121,8 @@ def rows_for(path):
             "compile_s": compile_seconds(d),
             "int100": interior_rate(d, 100),
             "int300": interior_rate(d, 300),
+            "overlap": overlap_ratio(d),
+            "coldx": cold_ratio(d),
             "file": os.path.relpath(f, ROOT),
         }
         if "objectives_list" in d:  # moeva: one row per eps
@@ -132,6 +167,11 @@ def main():
     print("generation budgets (`telemetry.quality`, runs with `quality_every`")
     print("set) — the saturation-proof convergence evidence; `—` for runs that")
     print("recorded no interior sample (strict runs and pre-round-6 metrics).")
+    print("`overlap` is the device overlap ratio (`telemetry.gaps`: device-busy")
+    print("seconds over compile-free wall; 1.0 = the host never left the device")
+    print("idle) and `cold×` the run's cold multiplier (compile-inclusive wall")
+    print("over run-only wall, from the cost ledger's compile seconds); `—` for")
+    print("warm runs and metrics predating the dispatch-gap ledger (pre-round-9).")
     print()
     print("Grid points ABSENT from a table failed the evaluator's scaled-range")
     print("assert (`objective_calculator.py:72-76` parity: candidates outside the")
@@ -151,9 +191,12 @@ def main():
         print(
             "| attack | scenario/model | budget | ε "
             "| o1 | o2 | o3 | o4 | o5 | o6 | o7 | time (s) | cmp "
-            "| compile (s) | o@100 | o@300 |"
+            "| compile (s) | o@100 | o@300 | overlap | cold× |"
         )
-        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+        print(
+            "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---"
+            "|---|---|---|"
+        )
         for r in sorted(
             rows, key=lambda r: (r["attack"], r["model"], r["budget"] or 0, str(r["eps"]))
         ):
@@ -161,7 +204,7 @@ def main():
             print(
                 f"| {r['attack']} | {r['model']} | {r['budget']} | {r['eps']} "
                 f"| {cells} | {r['time_s']} | {r['compile']} | {r['compile_s']} "
-                f"| {r['int100']} | {r['int300']} |"
+                f"| {r['int100']} | {r['int300']} | {r['overlap']} | {r['coldx']} |"
             )
     print()
 

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""One tier-1 repo-check entrypoint with a per-gate pass/fail summary.
+
+The repo check grew one flag per observability PR — the raw incantation
+was ``python tools/bench_diff.py --check --slo --mesh --overlap`` plus
+``python tools/shard_lint.py --check --selftest`` — and every test/doc
+call site had to keep the flag list in sync by hand. This wrapper is the
+single source of truth for "what does tier-1 enforce":
+
+    python tools/repo_check.py                 # every gate
+    python tools/repo_check.py --only bench_diff
+    python tools/repo_check.py --only shard_lint --selftest
+    python tools/repo_check.py --json          # + one machine-readable line
+
+Gates (each runs as a subprocess of the same interpreter, so a gate that
+initializes JAX — shard_lint builds the emulated 8-device mesh — cannot
+pollute another gate's process state):
+
+- ``bench_diff`` — the perf+quality+SLO+mesh+overlap watchdog over the
+  committed ``BENCH_r*.json`` series (``tools/bench_diff.py --check
+  --slo --mesh --overlap``): wall-clock regressions (ledger-normalized),
+  interior-success-rate drift, serving knee/p99, per-device balance +
+  hot-loop collectives, and the device overlap / cold-steady ratios.
+- ``shard_lint`` — the states-sharding contract (``tools/shard_lint.py
+  --check``): compiles the committed attack programs on the emulated
+  8-device CPU mesh and fails on hot-loop float collectives, oversized
+  collective payloads, implicit host↔device transfers, or unintended
+  full replication. ``--selftest`` additionally proves the lint still
+  trips on injected violations.
+
+Exit code: 0 iff every selected gate passed. The summary prints one line
+per gate; ``--json`` appends ``{"ok", "gates": {name: {"rc", "ok"}}}``
+as the LAST line for CI annotation (per-gate detail stays in each gate's
+own captured output above it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: gate name -> argv tail (after ``sys.executable tools/<script>.py``).
+#: THE flag list tier-1 enforces — tests and docs reference this file
+#: instead of re-spelling it.
+GATES = {
+    "bench_diff": (
+        "bench_diff.py",
+        ["--check", "--slo", "--mesh", "--overlap"],
+    ),
+    "shard_lint": ("shard_lint.py", ["--check"]),
+}
+
+
+def run_gate(
+    name: str, extra: list[str], timeout: float, cwd: str | None
+) -> dict:
+    script, args = GATES[name]
+    cmd = [sys.executable, os.path.join(HERE, script), *args, *extra]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=cwd
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (
+            e.stdout or ""
+        )
+        err = f"gate timed out after {timeout:.0f}s"
+    return {
+        "name": name,
+        "cmd": cmd,
+        "rc": rc,
+        "ok": rc == 0,
+        "seconds": round(time.perf_counter() - t0, 1),
+        "stdout": out,
+        "stderr": err,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--only",
+        action="append",
+        choices=sorted(GATES),
+        help="run only this gate (repeatable); default: every gate",
+    )
+    parser.add_argument(
+        "--selftest",
+        action="store_true",
+        help="also pass --selftest to shard_lint (prove the lint trips "
+        "on injected violations)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="append one machine-readable JSON summary line (and pass "
+        "--json through to gates that support it)",
+    )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=540.0,
+        help="per-gate subprocess timeout in seconds (default 540; "
+        "shard_lint compiles every attack program on the emulated mesh)",
+    )
+    parser.add_argument(
+        "--cwd",
+        default=None,
+        help="repo root to check (default: the current directory — "
+        "bench_diff globs BENCH_r*.json there)",
+    )
+    args = parser.parse_args(argv)
+
+    names = args.only or sorted(GATES)
+    results = []
+    for name in names:
+        extra: list[str] = []
+        if args.json:
+            extra.append("--json")
+        if name == "shard_lint" and args.selftest:
+            extra.append("--selftest")
+        res = run_gate(name, extra, args.timeout, args.cwd)
+        results.append(res)
+        sys.stdout.write(res["stdout"])
+        if res["stderr"]:
+            sys.stderr.write(res["stderr"])
+
+    print("repo_check summary:")
+    for res in results:
+        verdict = "PASS" if res["ok"] else f"FAIL (rc={res['rc']})"
+        print(f"  {res['name']:<12} {verdict}  [{res['seconds']}s]")
+    ok = all(r["ok"] for r in results)
+    print(f"repo_check: {'ok' if ok else 'FAILING'}")
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "gates": {
+                        r["name"]: {
+                            "rc": r["rc"],
+                            "ok": r["ok"],
+                            "seconds": r["seconds"],
+                        }
+                        for r in results
+                    },
+                }
+            )
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,48 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def bound_record(rec: dict) -> dict:
+    """Bound every list-valued telemetry field of the printed record.
+
+    The committed ``BENCH_r*.json`` driver wrapper stores bench stdout's
+    one JSON line; an unbounded line — hundreds of ledger entries, every
+    cold-classified executable, a full-budget quality curve — risks
+    wrapper-side truncation, which parses as an EMPTY ``parsed`` payload
+    and silently drops the very telemetry the ``bench_diff --overlap``
+    / ``--cold`` gates need (r05's ``parsed.telemetry`` came back empty
+    exactly this way). Every gated scalar (overlap_ratio,
+    cold_steady_ratio, flops_total, interior rates, knee, by_outcome
+    counts) is kept exact; only the long per-item lists are capped, each
+    with an ``<key>_omitted`` count — bounded, never silently truncated.
+    Mutates and returns ``rec`` (called right before the final print)."""
+
+    def cap(d, key, n, sort_key=None):
+        lst = d.get(key)
+        if isinstance(lst, list) and len(lst) > n:
+            if sort_key is not None:
+                lst = sorted(lst, key=sort_key, reverse=True)
+            d[key + "_omitted"] = len(lst) - n
+            d[key] = lst[:n]
+
+    subs = [rec] + [
+        rec.get(k) for k in ("real_botnet", "early_exit", "serving")
+        if isinstance(rec.get(k), dict)
+    ]
+    for sub in subs:
+        tele = sub.get("telemetry") or {}
+        cap(
+            tele.get("cost") or {}, "entries", 12,
+            sort_key=lambda e: e.get("dispatches") or 0,
+        )
+        cap(tele.get("quality") or {}, "curve", 24)
+    cap(((rec.get("real_botnet") or {}).get("quality")) or {}, "curve", 24)
+    cap(
+        ((rec.get("cold") or {}).get("persistent_cache")) or {},
+        "by_executable", 24,
+    )
+    return rec
+
+
 def np_lcld_constraints(x):
     """Numpy twin of the 10 LCLD formulas (for CPU cost measurement only)."""
     def months(f):
@@ -534,12 +576,7 @@ def run_serving_bench() -> dict | None:
         from moeva2_ijcai22_replication_tpu.observability import get_coldstart
 
         cs = get_coldstart()
-
-        def _compile_phase_s():
-            ph = cs.cold_block().get("phases") or {}
-            return ph.get("trace_lower", 0.0) + ph.get("xla_compile", 0.0)
-
-        compile0 = _compile_phase_s()
+        compile0 = cs.compile_phase_seconds()
         t0 = time.perf_counter()
         for b in service.menu.sizes:
             service.attack(
@@ -554,7 +591,8 @@ def run_serving_bench() -> dict | None:
         # which note_compile already booked under trace_lower/xla_compile
         # (the phases must decompose the cold wall, not double-count it)
         get_coldstart().record_phase(
-            "device_warmup", max(warmup_s - (_compile_phase_s() - compile0), 0.0)
+            "device_warmup",
+            max(warmup_s - (cs.compile_phase_seconds() - compile0), 0.0),
         )
 
         record = offered_load_sweep(service, make_request, loads, n_requests)
@@ -590,7 +628,7 @@ def main():
         if rec:
             out["execution"] = rec.get("execution")
             out["telemetry"] = rec.get("telemetry")
-        return out
+        return bound_record(out)
 
     # --serving: ONLY the request-path sweep — no grid subprocesses, no
     # network, one process; the CI-reproducible serving record.
@@ -831,7 +869,9 @@ def main():
         # a crashed grid must not satisfy the whole-grid-evidence item
         if "warm_s" in grid and "warm_rc" not in grid and grid.get("warm_runs"):
             record["grid_wallclock_s"] = grid["warm_s"]
-    print(json.dumps(record))
+    # bounded print: the driver wrapper must never truncate the line the
+    # watchdog gates parse (the satellite — see bound_record)
+    print(json.dumps(bound_record(record)))
 
 
 if __name__ == "__main__":

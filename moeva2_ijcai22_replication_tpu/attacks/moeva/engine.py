@@ -117,6 +117,11 @@ class _InFlightRun:
     gate_every: int = 0
     qual_latest: Any = None
     qual_samples: list = None
+    #: deferred host tail of the last gate (double-buffering): finalize
+    #: runs it before reading the parked/quality state — under chunking
+    #: that happens one dispatch late, so the flush overlaps the NEXT
+    #: chunk's device work too.
+    flush_gate: Any = None
 
 
 @dataclass
@@ -218,6 +223,19 @@ class Moeva2:
     #: (``experiments.common.DEFAULT_BUCKET_SIZES``). Sizes not divisible by
     #: the mesh size are skipped (states-axis sharding contract).
     compaction_buckets: tuple | None = None
+    #: generation double-buffering (default on): each gate's host-side
+    #: tail — the packed-stats scatter into the quality buffer, the
+    #: parked-population fetch + merge, and the gate progress events —
+    #: is deferred until the NEXT segment is already enqueued, so it runs
+    #: while the device executes that segment instead of idling it (the
+    #: PR-3 launch/finalize split extended from chunks to gate segments).
+    #: The only remaining inter-segment sync is the tiny packed (S, 9)
+    #: stats fetch the park/compaction DECISION needs. Pure host-side
+    #: scheduling: device programs, dispatch order, and RNG streams are
+    #: untouched, so ``False`` (serial flush, the pre-double-buffer
+    #: schedule) is bit-identical with zero extra compiles — pinned by
+    #: tier-1 ``tests/test_double_buffer.py``.
+    double_buffer: bool = True
     #: record the convergence-quality history (``MoevaResult.quality``):
     #: per-gate engine-space o1–o7 rates, best/mean constraint violation,
     #: best distance, judged by the same criterion the early-exit gate uses
@@ -285,6 +303,11 @@ class Moeva2:
         self._jit_init = None
         self._jit_segment = None
         self._jit_success = None
+        self._jit_take = None
+        #: gate host-work flushes that actually overlapped the next
+        #: segment's device execution in the most recent ``generate`` —
+        #: the double-buffer's structural witness (0 in serial mode).
+        self.last_deferred_gate_flushes = 0
         #: success-gate scalar args (threshold, ε) placed once per engine:
         #: on a mesh they must be explicitly replicated — a device-0 scalar
         #: would be implicitly respread across the mesh at every gate
@@ -315,6 +338,15 @@ class Moeva2:
         return {
             "engine": "moeva2",
             "cache_key": getattr(self, "cache_key", None),
+            # stable domain identity: the constraint set is CODE traced
+            # into the compiled program (unlike the model weights, which
+            # are runtime arguments), so the persistent AOT cache needs a
+            # process-independent field discriminating domains of equal
+            # shape — the engine-cache slot id above hashes object id()s
+            # and cannot serve across processes
+            "constraints": type(self.constraints).__name__,
+            "n_features": self.codec.n_features,
+            "n_constraints": self.constraints.get_nb_constraints(),
             "norm": str(self.norm),
             "n_pop": self.n_pop,
             "pop_size": self.pop_size,
@@ -630,6 +662,7 @@ class Moeva2:
         chunk = self.effective_states_chunk()
         self._dispatch_log = []
         self._balance_log = []
+        self.last_deferred_gate_flushes = 0
         t0 = time.perf_counter()
         try:
             if chunk and s > chunk:
@@ -777,31 +810,31 @@ class Moeva2:
         return BucketMenu(sizes) if sizes else None
 
     def _success_mask(self, carry):
-        """``(mask, stats)`` on-device gate outputs from the carried
-        objectives: the (S,) success mask — the ObjectiveCalculator
-        criterion (misclassified ∧ Σ violations = 0 ∧ within ε) over
-        population ∪ archive — plus the (S, 9) per-state quality stats
-        (``attacks.objective.QUALITY_STAT_COLUMNS``) judged by the same
-        criterion. One tiny program computes both unconditionally, so
-        quality capture on/off shares the same executable and dispatch
-        schedule; its outputs are the only device→host traffic between
-        gated segments, and the caller fetches only the leaves it needs."""
+        """The packed (S, 9) on-device gate output from the carried
+        objectives: the per-state quality stats
+        (``attacks.objective.QUALITY_STAT_COLUMNS``) judged over
+        population ∪ archive by the ObjectiveCalculator criterion
+        (misclassified ∧ Σ violations = 0 ∧ within ε). The (S,) success
+        mask IS the o7 column (``stats[..., 6] > 0``), derived host-side
+        after the fetch — so each gate costs exactly ONE packed
+        device→host transfer (the roofline satellite: the former
+        bool-mask fetch and stats fetch were two round trips over a
+        tunnelled device). One tiny program computes the stats
+        unconditionally, so quality capture on/off shares the same
+        executable and dispatch schedule."""
 
         if self._jit_success is None:
 
-            def success_mask(pop_f, arch_f, thr, eps):
+            def gate_stats(pop_f, arch_f, thr, eps):
                 f = (
                     jnp.concatenate([pop_f, arch_f], axis=1)
                     if arch_f.shape[1]
                     else pop_f
                 )
-                stats = engine_quality_stats(f, thr, eps, xp=jnp)
-                # o7 column: any misclassified ∧ feasible ∧ within-ε
-                # candidate — exactly the early-exit success criterion
-                return stats[..., 6] > 0, stats
+                return engine_quality_stats(f, thr, eps, xp=jnp)
 
             self._jit_success = LedgeredJit(
-                jax.jit(success_mask),
+                jax.jit(gate_stats),
                 producer="moeva_success",
                 identity=self._ledger_identity,
                 describe_args=lambda pop_f, *rest: {
@@ -826,29 +859,63 @@ class Moeva2:
         return self._jit_success(carry[1], carry[3], *self._gate_scalars)
 
     def _take_carry(self, carry, sel: np.ndarray):
-        """Repack the carry's states axis to ``sel`` (device-side gather —
-        the populations never round-trip through host memory)."""
-        pop_x, pop_f, arch_x, arch_f, norm_state, key = carry
-        sel_dev = jnp.asarray(sel)
-        take = lambda a: jnp.take(a, sel_dev, axis=0)  # noqa: E731
-        out = (
-            take(pop_x),
-            take(pop_f),
-            take(arch_x),
-            take(arch_f),
-            jax.tree.map(take, norm_state),
-            key,
-        )
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
+        """Repack the carry's states axis to ``sel`` through ONE jitted
+        fused gather (device-side — the populations never round-trip
+        through host memory). The op-by-op eager gather this replaces
+        enqueued ~10 separate device ops per compaction (one per carry
+        leaf plus the mesh re-placements); the fused executable is one
+        dispatch — one round trip over a tunnelled device. One tiny
+        executable per (source shape, bucket) pair, bounded by the menu
+        length like the segment programs, and dispatched through
+        :class:`LedgeredJit` like every other engine program so its
+        compiles land in the cost/cold ledgers and the persistent AOT
+        cache (an uninstrumented compile would re-pay trace+compile per
+        process and leak its first-call wall into run attribution).
+        Donating the source carry was tried and recorded as a negative
+        result: XLA input-output aliasing requires equal shapes, and a
+        shrinking gather can never alias, so ``donate_argnums`` only
+        produced unusable-donation warnings (docs/DESIGN.md § spending
+        the ledger, donation inventory) — the source buffers free via
+        the normal refcount when the caller rebinds the carry."""
+        if self._jit_take is None:
+            mesh, axis = self.mesh, self.states_axis
 
-            sh = NamedSharding(self.mesh, PartitionSpec(self.states_axis))
-            out = (
-                *(jax.device_put(a, sh) for a in out[:4]),
-                jax.tree.map(lambda a: jax.device_put(a, sh), out[4]),
-                key,
+            def take(carry, sel):
+                pop_x, pop_f, arch_x, arch_f, norm_state, key = carry
+                gather = lambda a: jnp.take(a, sel, axis=0)  # noqa: E731
+                out = (
+                    gather(pop_x),
+                    gather(pop_f),
+                    gather(arch_x),
+                    gather(arch_f),
+                    jax.tree.map(gather, norm_state),
+                    key,
+                )
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    sh = NamedSharding(mesh, PartitionSpec(axis))
+                    cons = lambda a: jax.lax.with_sharding_constraint(  # noqa: E731
+                        a, sh
+                    )
+                    out = (
+                        *(cons(a) for a in out[:4]),
+                        jax.tree.map(cons, out[4]),
+                        key,
+                    )
+                return out
+
+            self._jit_take = LedgeredJit(
+                jax.jit(take),
+                producer="moeva_compact",
+                identity=self._ledger_identity,
+                describe_args=lambda carry, sel: {
+                    "rows": int(carry[0].shape[0]),
+                    "bucket": int(sel.shape[0]),
+                },
+                on_dispatch=self._on_ledger_dispatch,
             )
-        return out
+        return self._jit_take(carry, jnp.asarray(sel))
 
     def _final_columns(self, carry, idx: np.ndarray):
         """Rows ``idx``'s returned-population columns (pop + archive)."""
@@ -1054,6 +1121,60 @@ class Moeva2:
                             x, minimize_class, xl_ml, xu_ml, row_src
                         )
         menu = self._compaction_menu() if check else None
+        #: at most one gate's deferred host work (list so the flush
+        #: closure can pop without nonlocal rebinding)
+        pending_gate: list = []
+
+        def flush_gate():
+            """Run the last gate's host-side tail: the quality-stats
+            scatter, the parked-population fetch + merge, and the gate
+            progress events. Under double-buffering this executes AFTER
+            the next segment was enqueued — the fetched ``px``/``pf``
+            gathers were dispatched before that segment on the serial
+            device queue, so the device_get here waits only for the tiny
+            gathers while the segment's generations overlap the host
+            work. All decisions (park set, compaction) were already made
+            at gate time; this is bookkeeping, so serial mode
+            (``double_buffer=False``) is bit-identical."""
+            if not pending_gate:
+                return
+            g = pending_gate.pop()
+            if g["dispatches_at"] < len(self._dispatch_log):
+                # at least one dispatch was enqueued since the gate:
+                # this flush genuinely overlapped device work (the
+                # double-buffer's structural witness, pinned by tests)
+                self.last_deferred_gate_flushes += 1
+            if qual_on:
+                # scatter the packed stats home: pads (row_live False)
+                # never overwrite a real row, parked rows keep the stats
+                # frozen at park time (row_src/row_live are the gate-time
+                # copies — compaction may have remapped them since)
+                live = g["row_live"]
+                qual_latest[g["row_src"][live]] = g["stats"][live]
+                qual_samples.append(
+                    sample_from_per_state(g["gen"], qual_latest)
+                )
+                if not check:
+                    # quality-only gate (strict semantics, no early
+                    # exit): rounded progress event, full precision in
+                    # the history
+                    sf = qual_samples[-1]["success_frac"]
+                    self._trace_event(
+                        "moeva.quality",
+                        gen=int(g["gen"]),
+                        success_frac=None if sf is None else round(sf, 4),
+                    )
+            if g.get("px") is not None:
+                # park merge: freeze the solved rows' populations on host
+                with maybe_span(
+                    self.trace, "parked_merge", rows=int(len(g["park_rows"]))
+                ):
+                    px, pf = jax.device_get((g["px"], g["pf"]))
+                    parked["x"][g["park_rows"]] = px
+                    parked["f"][g["park_rows"]] = pf
+            if g.get("event") is not None:
+                self._trace_event("moeva.gate", **g["event"])
+
         while done < n_steps:
             length = min(chunk, n_steps - done)
             if gate_every:
@@ -1099,41 +1220,41 @@ class Moeva2:
                 hist_chunks.append(arr)
                 pending = None
 
+            # generation double-buffering: the PREVIOUS gate's host-side
+            # tail runs here, while the segment just enqueued executes on
+            # device — the top_gap_stages the PR-9 tracker attributed
+            # (gate quality fetch, parked_merge) move off the device's
+            # critical path. No-op in serial mode (already flushed).
+            flush_gate()
             if self.save_history:
                 # the next segment is already enqueued (async dispatch), so
                 # fetching the *previous* chunk overlaps with its compute
                 flush_pending()
                 pending = gen_hist
             if gate_every and done % gate_every == 0 and done < n_steps:
-                succ_dev, stats_dev = self._success_mask(carry)
-                if qual_on:
-                    # fetch the per-state stats leaf and scatter it home:
-                    # pads (row_live False) never overwrite a real row,
-                    # parked rows keep the stats frozen at park time
-                    with maybe_span(self.trace, "gate_fetch", what="quality"):
-                        stats = np.asarray(jax.device_get(stats_dev))
-                    qual_latest[row_src[row_live]] = stats[row_live]
-                    qual_samples.append(
-                        sample_from_per_state(done, qual_latest)
+                # the ONE remaining inter-segment sync: a single packed
+                # (S, 9) stats fetch — the park/compaction decision needs
+                # it (the success mask is its o7 column)
+                with maybe_span(self.trace, "gate_fetch", what="stats"):
+                    stats = np.asarray(
+                        jax.device_get(self._success_mask(carry))
                     )
-                if not check:
-                    # quality-only gate (strict semantics, no early exit):
-                    # rounded progress event, full precision in the history
-                    sf = qual_samples[-1]["success_frac"]
-                    self._trace_event(
-                        "moeva.quality",
-                        gen=int(done),
-                        success_frac=None if sf is None else round(sf, 4),
-                    )
+                succ = stats[..., 6] > 0
+                gate = {
+                    "gen": done,
+                    "stats": stats,
+                    # gate-time copies: compaction below remaps the live
+                    # arrays before the deferred flush reads them
+                    "row_src": row_src.copy(),
+                    "row_live": row_live.copy(),
+                }
                 if check:
-                    with maybe_span(self.trace, "gate_fetch", what="mask"):
-                        succ = np.asarray(jax.device_get(succ_dev))
                     solved = row_live & succ
                     n_parked = int(solved.sum())
                     if n_parked:
-                        # park: freeze the solved rows' returned populations
-                        # on host — success observed now can no longer be
-                        # lost, archive or not
+                        # park decision NOW (host bools — checkpoint and
+                        # finalize need the mask); the population fetch +
+                        # merge is the flush's business
                         idx = np.where(solved)[0]
                         if parked is None:
                             cols = self.pop_size + self.archive_size
@@ -1147,25 +1268,25 @@ class Moeva2:
                                     (s, cols, 3), dtype=np.dtype(self.dtype)
                                 ),
                             }
-                        with maybe_span(
-                            self.trace, "parked_merge", rows=int(n_parked)
-                        ):
-                            px, pf = jax.device_get(
-                                self._final_columns(carry, idx)
-                            )
-                            parked["mask"][row_src[idx]] = True
-                            parked["x"][row_src[idx]] = px
-                            parked["f"][row_src[idx]] = pf
+                        rows = row_src[idx]
+                        parked["mask"][rows] = True
+                        gate["park_rows"] = rows
+                        # device-side gather of the solved rows' returned
+                        # populations — enqueued BEFORE the compaction
+                        # gather donates this carry and before the next
+                        # segment dispatch, fetched at the next flush
+                        gate["px"], gate["pf"] = self._final_columns(
+                            carry, idx
+                        )
                         row_live = row_live & ~succ
                     n_active = int(row_live.sum())
                     if n_active == 0:
                         # every state holds a success: skip the rest of the
-                        # budget
+                        # budget (the flush runs at finalize)
                         trace.append(
                             {"gen": done, "active": 0, "bucket": len(row_src)}
                         )
-                        self._trace_event(
-                            "moeva.gate",
+                        gate["event"] = dict(
                             gen=int(done),
                             active=0,
                             parked=int(n_parked),
@@ -1173,6 +1294,8 @@ class Moeva2:
                             bucket=int(len(row_src)),
                             early_exit=True,
                         )
+                        gate["dispatches_at"] = len(self._dispatch_log)
+                        pending_gate.append(gate)
                         break
                     bucket = (
                         menu.shrink_bucket(n_active, len(row_src))
@@ -1218,25 +1341,34 @@ class Moeva2:
                         )
                     # per-gate progress event: generation index, cumulative
                     # success fraction, active set, and the (possibly just
-                    # shrunk) dispatch bucket — the between-gates visibility
-                    # the early-exit scan lacked. The payload rounds for
+                    # shrunk) dispatch bucket. The payload rounds for
                     # display; the recorded quality history keeps the full-
-                    # precision numbers.
-                    self._trace_event(
-                        "moeva.gate",
+                    # precision numbers. Emitted at the deferred flush.
+                    gate["event"] = dict(
                         gen=int(done),
                         active=n_active,
                         parked=int(n_parked),
                         success_frac=round(1.0 - n_active / s, 4),
                         bucket=int(len(row_src)),
                     )
+                # dispatch watermark taken AFTER the decision block: the
+                # (ledgered) compaction gather is part of THIS gate's own
+                # work, not the next segment — only dispatches enqueued
+                # after the gate parks count as overlap for the witness
+                gate["dispatches_at"] = len(self._dispatch_log)
+                pending_gate.append(gate)
+                if not self.double_buffer:
+                    flush_gate()
             if (
                 cp is not None
                 and done < n_steps
                 and done % self.checkpoint_every == 0
             ):
-                # a snapshot only counts history already durable on disk
+                # a snapshot only counts history already durable on disk —
+                # and the parked populations must be merged before the
+                # early-stop extra freezes them into the snapshot
                 flush_pending()
+                flush_gate()
                 cp.save(
                     carry,
                     done,
@@ -1265,20 +1397,34 @@ class Moeva2:
             gate_every=gate_every,
             qual_latest=qual_latest,
             qual_samples=qual_samples,
+            flush_gate=flush_gate,
         )
 
     def _finalize_one(self, run: _InFlightRun) -> MoevaResult:
+        if run.flush_gate is not None:
+            # drain the last gate's deferred host tail (parked merge,
+            # quality scatter, events) before reading that state below
+            run.flush_gate()
         if run.pending is not None:
             with maybe_span(self.trace, "fetch", what="history"):
                 run.hist_chunks.append(np.asarray(jax.device_get(run.pending)))
             run.pending = None
         pop_x, pop_f, arch_x, arch_f, _, _ = run.carry
-        if self.archive_size:
-            # archive members join the returned populations (extra columns)
-            pop_x = jnp.concatenate([pop_x, arch_x], axis=1)
-            pop_f = jnp.concatenate([pop_f, arch_f], axis=1)
         with maybe_span(self.trace, "fetch", what="populations"):
-            pop_x, pop_f = jax.device_get((pop_x, pop_f))
+            if self.archive_size:
+                # archive members join the returned populations (extra
+                # columns) — concatenated on HOST from one coalesced
+                # fetch: the former device-side concat allocated a
+                # transient (S, P+A, L) copy in HBM and cost two extra
+                # dispatches plus a second fetch, for arrays that were
+                # about to cross to host anyway
+                pop_x, pop_f, arch_x, arch_f = jax.device_get(
+                    (pop_x, pop_f, arch_x, arch_f)
+                )
+                pop_x = np.concatenate([pop_x, arch_x], axis=1)
+                pop_f = np.concatenate([pop_f, arch_f], axis=1)
+            else:
+                pop_x, pop_f = jax.device_get((pop_x, pop_f))
         s = run.x.shape[0]
         if run.parked is not None or len(run.row_src) != s:
             # merge: parked rows keep their frozen populations; surviving

@@ -109,6 +109,11 @@ class ConstrainedPGD:
         return {
             "engine": type(self).__name__,
             "cache_key": getattr(self, "cache_key", None),
+            # stable domain identity for the persistent AOT cache: the
+            # constraint formulas are traced into the executable, and the
+            # engine-cache slot id above is id()-derived (process noise)
+            "constraints": type(self.constraints).__name__,
+            "n_constraints": int(self.constraints.n_constraints),
             "loss_evaluation": self.loss_evaluation,
             "constraints_optim": self.constraints_optim,
             "norm": str(self.norm),
@@ -433,22 +438,28 @@ class ConstrainedPGD:
         # unchanged below)
         jax.block_until_ready(out)
         t_run_end = time.perf_counter()
+        # ONE coalesced device→host fetch for all three result leaves
+        # (roofline satellite): the former per-leaf device_get calls were
+        # three sequential round trips — measurable when the accelerator
+        # sits behind a network tunnel. The unused leaves are scalar
+        # zeros, so the coalesced fetch moves no extra bytes.
+        out_h, hist_h, succ_h = jax.device_get((out, hist, succ_curve))
         # (N, max_iter, C) — runners add the reference's unit axis on save
         # (01_pgd_united.py:196-199).
         self.loss_history = (
-            np.swapaxes(np.asarray(jax.device_get(hist)), 0, 1)
+            np.swapaxes(np.asarray(hist_h), 0, 1)
             if self.record_loss
             else None
         )
         if self.num_random_init:
-            succ = np.asarray(jax.device_get(succ_curve), bool)
+            succ = np.asarray(succ_h, bool)
             self.quality_history = {
                 "restart_success": succ,
                 "restart_flip_frac": succ.mean(axis=1).tolist(),
             }
         else:
             self.quality_history = None
-        x_out = np.asarray(jax.device_get(out))
+        x_out = np.asarray(out_h)
         # roofline attribution: this fetch is the dispatch's sync point, so
         # dispatch->fetched wall-clock (compile excluded) is the run time of
         # exactly one executable

@@ -204,10 +204,14 @@ def setup_jax_cache(config: dict | None = None) -> None:
     per program shape; an rq grid revisits the same handful of shapes across
     many processes). ``system.jax_cache_dir: ""`` disables.
 
-    Also applies ``system.cost_ledger``, ``system.mesh_telemetry``, and
-    ``system.gap_telemetry`` (all default on): this is the one
+    Also applies ``system.cost_ledger``, ``system.mesh_telemetry``,
+    ``system.gap_telemetry`` (all default on), and ``system.aot_cache``
+    (the serialized-executable tier — default ``<jax_cache_dir>/aot``
+    whenever the jax cache is on, so warm processes skip
+    trace+lower+compile entirely; ``""`` disables): this is the one
     process-level setup hook every runner and bench path already calls —
     which also makes it the cold-start ledger's "imports are done" marker."""
+    from ..observability.aotcache import configure_aot_cache
     from ..observability.coldstart import configure_coldstart
     from ..observability.gaps import configure_gap_tracker
     from ..observability.ledger import configure_ledger
@@ -223,6 +227,18 @@ def setup_jax_cache(config: dict | None = None) -> None:
     cache_dir = ".jax_cache"
     if config is not None:
         cache_dir = config.get("system", {}).get("jax_cache_dir", cache_dir)
+    # the AOT dir defaults INSIDE the jax cache dir so both tiers share
+    # one volume/symlink layout (the bench grid symlinks .jax_cache into
+    # its working dirs and gets the serialized executables for free);
+    # created eagerly so the jax-cache entry census counts it from start
+    aot = configure_aot_cache(
+        config, default_dir=os.path.join(cache_dir, "aot") if cache_dir else None
+    )
+    if aot.enabled:
+        try:
+            os.makedirs(aot.path, exist_ok=True)
+        except OSError:
+            pass
     if not cache_dir:
         coldstart.configure_cache(None, False)
         return

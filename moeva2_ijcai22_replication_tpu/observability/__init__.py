@@ -23,6 +23,13 @@ keep every committed record carrying the shared ``execution`` +
 ``telemetry`` schema.
 """
 
+from .aotcache import (
+    AOT_CACHE,
+    AotExecutableCache,
+    backend_fingerprint,
+    configure_aot_cache,
+    get_aot_cache,
+)
 from .capacity import CapacityModel
 from .coldstart import (
     COLD_KEYS,
@@ -108,6 +115,8 @@ from .trace import (
 )
 
 __all__ = [
+    "AOT_CACHE",
+    "AotExecutableCache",
     "COLD_KEYS",
     "COLDSTART",
     "DEFAULT_INTERIOR_BUDGETS",
@@ -136,7 +145,9 @@ __all__ = [
     "Trace",
     "TraceRecorder",
     "all_device_memory_stats",
+    "backend_fingerprint",
     "build_identity",
+    "configure_aot_cache",
     "configure_coldstart",
     "configure_gap_tracker",
     "configure_ledger",
@@ -147,6 +158,7 @@ __all__ = [
     "detect_knee",
     "device_memory_stats",
     "emit_window_trace",
+    "get_aot_cache",
     "get_coldstart",
     "get_gap_tracker",
     "get_ledger",

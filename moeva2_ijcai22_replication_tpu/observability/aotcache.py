@@ -1,0 +1,369 @@
+"""Persistent AOT executable cache: skip trace+lower+compile entirely.
+
+The jax persistent compilation cache (``setup_jax_cache``) only amortises
+the *XLA compile* — every new process still pays tracing and lowering for
+all ~400 entries (the PR-9 cold ledger measured trace_lower as a first-
+class cold phase), and the cache lookup itself happens inside
+``lower().compile()``. This module caches one level higher: the
+**serialized executable** (``jax.experimental.serialize_executable``) of
+every :class:`~.ledger.LedgeredJit` compile, keyed by the program's full
+dispatch identity, so a warm process deserializes the finished binary and
+never traces, lowers, or compiles at all.
+
+Key scheme (sha256 over a canonical JSON string):
+
+- producer (``pgd_attack``, ``moeva_segment``, …) and the LedgeredJit
+  compile-time identity (engine cache key, rows, scan length, loss
+  strategy, mesh description) — the same identity the cost ledger
+  records, minus the *ambient* :func:`~.ledger.ledger_context` attrs
+  (batch composition varies per dispatch and must not fragment the key);
+- the executable-cache key itself: static argument values, sorted
+  kwargs, the dynamic arguments' pytree structure, and every leaf's
+  (shape, dtype, weak_type, sharding) signature.
+
+Fingerprint scheme (stored INSIDE each entry, checked on load — a
+foreign file must be *found and rejected*, with a counted event, not
+silently never looked up): jax version, backend name, device kind, PJRT
+platform version, and visible device count. Any mismatch invalidates the
+entry (stale jax upgrade, foreign backend, different mesh topology) and
+the compile falls through to the normal path, overwriting the entry.
+
+Degradation contract (the satellite): corrupt, truncated, stale, or
+foreign cache files log a counted recorder event
+(``aot_cache_load_failures``, with a per-reason split in
+:meth:`AotExecutableCache.state`, surfaced on /healthz
+``build.jax_cache.aot``) and fall back to a fresh compile — the cache
+must never take an attack down. Stores are equally best-effort (a full
+disk degrades to plain compiles) and atomic (tmp + rename), so a reader
+never sees a half-written entry.
+
+Disabled by default: an unconfigured cache has no directory and both
+:meth:`load` and :meth:`store` are no-ops. ``setup_jax_cache`` wires
+config ``system.aot_cache`` (default: ``<jax_cache_dir>/aot`` whenever
+the jax persistent cache is on; ``""`` disables).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+
+#: envelope schema version — bump on any layout change so old entries
+#: reject cleanly (counted as ``stale``) instead of unpickling garbage.
+ENVELOPE_VERSION = 1
+
+
+def backend_fingerprint() -> dict:
+    """Identity of the compilation target an executable is only valid
+    for. Serialized executables embed device ids and backend-specific
+    binary code: loading one on a different jax/backend/topology is
+    undefined, so every field here gates the load. The ``package`` and
+    ``code`` fields make invalidation deliberately COARSE across
+    commits: constraint formulas are *code traced into the program* (not
+    runtime arguments like the model weights), so an executable is only
+    trusted within the checkout that built it — serving replicas, grid
+    reruns, and repeated bench invocations of one deployment share a
+    commit and still amortise fully."""
+    import jax
+
+    from .. import __version__
+    from .records import git_describe
+
+    try:
+        dev = jax.devices()[0]
+        platform_version = getattr(dev.client, "platform_version", None)
+        device_kind = getattr(dev, "device_kind", None)
+    except Exception:
+        platform_version = device_kind = None
+    code = git_describe()
+    if code is None or code.endswith("-dirty"):
+        # `git describe --dirty` cannot distinguish two DIFFERENT dirty
+        # states of one commit — a dirty-tree edit to a constraint
+        # formula would otherwise reuse a stale executable with the old
+        # formula baked in (the jax cache keys on traced HLO and is
+        # immune; this tier keys above tracing, so it must carry its own
+        # source identity). Stamp the package source instead: sorted
+        # (path, mtime_ns, size) of every .py file — the ArtifactCache
+        # validity discipline, no file reads, ~1 ms once per process.
+        code = f"{code}+{_source_stamp()}"
+    return {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": device_kind,
+        "platform_version": platform_version,
+        "device_count": jax.device_count(),
+        "package": __version__,
+        "code": code,
+    }
+
+
+def _source_stamp() -> str:
+    """Cheap content-identity of the package source tree: sha256 over
+    the sorted (relative path, mtime_ns, size) of every ``.py`` file.
+    Conservative by design — a touched-but-identical file invalidates
+    (a spurious recompile), an edited file always invalidates (never a
+    stale executable)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if not f.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, f)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            rows.append((os.path.relpath(p, root), st.st_mtime_ns, st.st_size))
+    rows.sort()
+    return hashlib.sha256(repr(rows).encode()).hexdigest()[:16]
+
+
+class AotExecutableCache:
+    """Disk-backed serialized-executable store for :class:`LedgeredJit`."""
+
+    def __init__(self, path: str | None = None):
+        self._lock = threading.Lock()
+        self.path = path
+        self._fingerprint: dict | None = None
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.load_failures = 0
+        self.store_failures = 0
+        #: load failures by reason: corrupt / fingerprint / deserialize
+        self.failure_reasons: dict[str, int] = {}
+        self.last_load_s = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def configure(self, path: str | None) -> None:
+        """Point the cache at ``path`` (None/"" disables). Counters are
+        process facts and survive reconfiguration."""
+        with self._lock:
+            self.path = path or None
+            self._fingerprint = None  # re-read lazily against the new dir
+
+    def fingerprint(self) -> dict:
+        if self._fingerprint is None:
+            self._fingerprint = backend_fingerprint()
+        return self._fingerprint
+
+    # -- keying --------------------------------------------------------------
+    @staticmethod
+    def cache_key(producer: str, identity: dict, exec_key) -> str:
+        """Stable cross-process key: producer + compile identity + the
+        LedgeredJit executable-cache key (statics, kwargs, treedef, leaf
+        avals). Everything is rendered through a canonical JSON string
+        (``default=repr`` for treedefs and other non-JSON leaves)."""
+        static, kwargs, treedef, leaves = exec_key
+        # the engine-cache slot id (identity["cache_key"]) hashes id()s
+        # of in-process artifact objects — stable within a process, noise
+        # across processes — so it must not fragment a DISK key. The
+        # stable parts of the identity (engine family, domain/constraint
+        # class, knobs, mesh, rows/length) plus the full aval signature
+        # carry the discrimination; model WEIGHTS are runtime arguments,
+        # so weight-independent executable sharing is correct by
+        # construction.
+        identity = {k: v for k, v in identity.items() if k != "cache_key"}
+        doc = {
+            "producer": producer,
+            "identity": identity,
+            "static": repr(static),
+            "kwargs": repr(kwargs),
+            "treedef": str(treedef),
+            "leaves": repr(leaves),
+        }
+        blob = json.dumps(doc, sort_keys=True, default=repr)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def _entry_path(self, key: str) -> str:
+        return os.path.join(self.path, f"{key}.aotx")
+
+    def _count_failure(self, reason: str, path: str | None = None) -> None:
+        with self._lock:
+            self.load_failures += 1
+            self.failure_reasons[reason] = (
+                self.failure_reasons.get(reason, 0) + 1
+            )
+        # the satellite contract: a swallowed deserialization failure must
+        # still be a counted, scrapeable event (PR-9 setup-failure style)
+        try:
+            from .trace import default_recorder
+
+            default_recorder().count("aot_cache_load_failures")
+        except Exception:
+            pass
+        # self-healing: a rejected entry stays rejected (corrupt bytes,
+        # stale fingerprint, undeserializable blob), and the recompile
+        # that follows may legitimately skip the re-store (a jax-cache
+        # hit) — without the discard every future process would pay the
+        # same counted failure forever
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- load/store ----------------------------------------------------------
+    def load(self, key: str):
+        """Deserialized ``jax.stages.Compiled`` for ``key``, or None on a
+        miss. Every failure mode — unreadable file, corrupt pickle, wrong
+        envelope version, fingerprint mismatch, deserialization error —
+        counts a ``aot_cache_load_failures`` event and returns None (the
+        caller compiles as if the cache did not exist)."""
+        if not self.enabled:
+            return None
+        path = self._entry_path(key)
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
+            return None
+        except OSError:
+            self._count_failure("corrupt", path)
+            return None
+        try:
+            env = pickle.loads(raw)
+            if (
+                not isinstance(env, dict)
+                or env.get("v") != ENVELOPE_VERSION
+                or not isinstance(env.get("payload"), bytes)
+            ):
+                raise ValueError("bad envelope")
+        except Exception:
+            self._count_failure("corrupt", path)
+            return None
+        if env.get("fingerprint") != self.fingerprint():
+            # stale jax / foreign backend / different topology: found and
+            # honestly rejected — the recompile below overwrites the entry
+            self._count_failure("fingerprint", path)
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            compiled = serialize_executable.deserialize_and_load(
+                env["payload"], env["in_tree"], env["out_tree"]
+            )
+        except Exception:
+            self._count_failure("deserialize", path)
+            return None
+        with self._lock:
+            self.hits += 1
+            self.last_load_s = time.perf_counter() - t0
+        return compiled
+
+    def store(self, key: str, compiled, *, producer: str | None = None) -> bool:
+        """Serialize ``compiled`` under ``key`` (atomic tmp+rename);
+        best-effort — an unserializable executable or a full disk counts
+        a store failure and returns False, never raises."""
+        if not self.enabled:
+            return False
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled
+            )
+            env = {
+                "v": ENVELOPE_VERSION,
+                "fingerprint": self.fingerprint(),
+                "producer": producer,
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            }
+            blob = pickle.dumps(env)
+            os.makedirs(self.path, exist_ok=True)
+            tmp = self._entry_path(key) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, self._entry_path(key))
+        except Exception:
+            with self._lock:
+                self.store_failures += 1
+            return False
+        with self._lock:
+            self.stores += 1
+        return True
+
+    # -- introspection -------------------------------------------------------
+    def entries(self) -> int | None:
+        if not self.enabled:
+            return None
+        try:
+            return sum(
+                1 for e in os.scandir(self.path) if e.name.endswith(".aotx")
+            )
+        except FileNotFoundError:
+            return 0
+        except OSError:
+            return None
+
+    def state(self) -> dict:
+        """The /healthz ``build.jax_cache.aot`` view (also embedded in the
+        cold ledger's ``persistent_cache`` block): dir, entry count, and
+        the hit/store/failure counters with the per-reason failure split."""
+        with self._lock:
+            return {
+                "dir": self.path,
+                "enabled": self.enabled,
+                "entries": self.entries(),
+                "hits": self.hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "load_failures": self.load_failures,
+                "load_failure_reasons": dict(self.failure_reasons),
+                "store_failures": self.store_failures,
+            }
+
+    def reset(self) -> None:
+        """Drop counters and detach the directory (tests only)."""
+        with self._lock:
+            self.path = None
+            self._fingerprint = None
+            self.hits = self.misses = self.stores = 0
+            self.load_failures = self.store_failures = 0
+            self.failure_reasons = {}
+            self.last_load_s = 0.0
+
+
+#: THE process cache — LedgeredJit consults it the way it consults the
+#: process CostLedger; unconfigured (no dir) it is a pair of no-ops.
+AOT_CACHE = AotExecutableCache()
+
+
+def get_aot_cache() -> AotExecutableCache:
+    return AOT_CACHE
+
+
+def configure_aot_cache(
+    config: dict | None, default_dir: str | None = None
+) -> AotExecutableCache:
+    """Apply config ``system.aot_cache``: an explicit directory, ``""`` to
+    disable, or absent → ``default_dir`` (``setup_jax_cache`` passes
+    ``<jax_cache_dir>/aot`` so the serialized executables ride the same
+    volume/symlink layout as the jax persistent cache)."""
+    if os.environ.get("MOEVA2_AOT_CACHE_DISABLE"):
+        # hermetic-test / CI escape: an AOT hit legitimately skips
+        # tracing, which would make trace-count-based assertions depend
+        # on what a PREVIOUS test session left on disk. Only this config
+        # path honors the switch — tests driving the cache explicitly use
+        # AotExecutableCache.configure directly.
+        AOT_CACHE.configure(None)
+        return AOT_CACHE
+    path = (config or {}).get("system", {}).get("aot_cache", None)
+    if path is None:
+        path = default_dir
+    AOT_CACHE.configure(path or None)
+    return AOT_CACHE

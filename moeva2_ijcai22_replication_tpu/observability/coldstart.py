@@ -25,7 +25,12 @@ right phase:
   XLA compile whose entry landed in the cache dir — new files appeared),
   ``miss_uncached`` (compiled but below the persistence threshold, or
   classified by the monitoring miss event), ``unknown`` (no signal
-  either way), ``disabled`` (no cache dir configured). The cache-dir
+  either way), ``disabled`` (no cache dir configured) — plus the
+  serialized-executable tier's verdicts (``observability.aotcache``):
+  ``aot_hit`` (the finished executable was deserialized from the AOT
+  cache — trace, lower, AND compile all skipped; the only cold cost is
+  the ``aot_load`` phase) and ``aot_stored`` (a real compile whose
+  serialized executable landed on disk for the next process). The cache-dir
   entry counts (start / now / added) surface the "N entries rebuilt per
   process" number directly. Classification is best-effort and documented
   approximate: monitoring deltas are process-global, so a concurrent
@@ -93,8 +98,14 @@ class ColdStartLedger:
         self._jax_hits = 0
         self._jax_misses = 0
         self._listener_registered = False
-        # per-executable classification rows
+        # per-executable classification rows (bounded ring — detail only)
         self.executables: list[dict] = []
+        #: UNBOUNDED per-outcome counters: ``by_outcome`` must cover the
+        #: whole process, not the last ``MAX_EXECUTABLES`` rows — the
+        #: bench_diff --cold warm-start hit rate gates on it, and a
+        #: ~400-executable process would otherwise evict its boot-time
+        #: aot_hits before the record is assembled
+        self.outcome_counts: dict[str, int] = {}
         self._first_dispatch: dict | None = None
 
     # -- phases --------------------------------------------------------------
@@ -162,17 +173,29 @@ class ColdStartLedger:
 
     def compile_probe(self) -> dict:
         """Pre-compile snapshot for :meth:`note_compile`'s per-executable
-        classification (monitoring counters + cache-dir entry count)."""
-        if not self.enabled:
-            return {}
+        classification (monitoring counters + cache-dir entry count).
+        The counters are returned even with capture off — the AOT cache's
+        store guard (:meth:`saw_cache_hit_since`) needs them regardless
+        of whether the cold-start *bookkeeping* is enabled."""
         with self._lock:
-            return {
-                "hits": self._jax_hits,
-                "misses": self._jax_misses,
-                "entries": _cache_dir_entries(
+            out = {"hits": self._jax_hits, "misses": self._jax_misses}
+            if self.enabled:
+                out["entries"] = _cache_dir_entries(
                     self.cache_dir if self.cache_enabled else None
-                ),
-            }
+                )
+            return out
+
+    def saw_cache_hit_since(self, probe: dict | None) -> bool:
+        """True when jax's persistent-cache monitoring reported a hit
+        since ``probe`` (a :meth:`compile_probe`). Best-effort: False
+        when monitoring is unavailable, and process-global — a
+        concurrent compile on another thread can read as a hit here
+        (the consumer, the AOT store guard, then merely skips a store).
+        """
+        if not probe or not self._listener_registered:
+            return False
+        with self._lock:
+            return self._jax_hits > probe.get("hits", self._jax_hits)
 
     def note_compile(
         self,
@@ -183,16 +206,29 @@ class ColdStartLedger:
         compile_s: float,
         probe: dict | None = None,
         aot: bool = True,
+        aot_cache: str | None = None,
     ) -> str:
         """Record one AOT compile's phase split and classify it against
-        the persistent cache; returns the classification."""
+        the persistent cache; returns the classification. ``aot_cache``
+        is the serialized-executable cache's verdict for this program
+        ("hit" = deserialized, trace+lower+compile all skipped — the
+        ``lower_s``/``compile_s`` booked here are the load wall-clock,
+        charged to ``aot_load``; "stored" = freshly compiled AND
+        serialized to disk for the next process)."""
         if not self.enabled:
             return "off"
-        self.record_phase("trace_lower", lower_s)
-        self.record_phase("xla_compile", compile_s)
+        if aot_cache == "hit":
+            # the whole trace/lower/compile pipeline was skipped: the only
+            # cold cost is the deserialize wall-clock, a phase of its own
+            self.record_phase("aot_load", lower_s + compile_s)
+        else:
+            self.record_phase("trace_lower", lower_s)
+            self.record_phase("xla_compile", compile_s)
         probe = probe or {}
         with self._lock:
-            if not aot:
+            if aot_cache == "hit":
+                outcome = "aot_hit"
+            elif not aot:
                 outcome = "fallback"
             elif not self.cache_enabled or not self.cache_dir:
                 outcome = "disabled"
@@ -216,6 +252,17 @@ class ColdStartLedger:
                     and entries_now > before
                 ):
                     outcome = "miss_stored"
+            if aot_cache == "stored" and outcome in (
+                "miss_uncached",
+                "miss_stored",
+                "unknown",
+                "disabled",
+            ):
+                # a real compile whose finished executable landed in the
+                # serialized-executable cache: the NEXT process's aot_hit.
+                # A jax-persistent-cache "hit" stays "hit" — the compile
+                # itself was already amortised, storing is a side effect.
+                outcome = "aot_stored"
             self.executables.append(
                 {
                     "key": key,
@@ -226,7 +273,23 @@ class ColdStartLedger:
                 }
             )
             del self.executables[:-MAX_EXECUTABLES]
+            self.outcome_counts[outcome] = (
+                self.outcome_counts.get(outcome, 0) + 1
+            )
         return outcome
+
+    def compile_phase_seconds(self) -> float:
+        """Total seconds this process spent producing executables — the
+        trace/lower + XLA-compile split plus AOT-cache deserializes
+        (``aot_load``). THE phase set warmup brackets subtract so their
+        ``device_warmup`` phase never double-counts seconds already
+        booked per-compile (one definition; the bench and serving
+        warmups both read it)."""
+        with self._lock:
+            return sum(
+                self.phases.get(k, 0.0)
+                for k in ("trace_lower", "xla_compile", "aot_load")
+            )
 
     def note_dispatch(self) -> None:
         """First compiled-program dispatch of the process (cheap: one
@@ -245,6 +308,8 @@ class ColdStartLedger:
         """The /healthz ``build.jax_cache`` view: dir, enabled/fallback
         state, the setup error if any, and the entry counts that surface
         the 'N entries rebuilt per process' number."""
+        from .aotcache import get_aot_cache
+
         with self._lock:
             now = _cache_dir_entries(self.cache_dir)
             return {
@@ -258,6 +323,11 @@ class ColdStartLedger:
                     if now is not None and self.cache_entries_start is not None
                     else None
                 ),
+                # serialized-executable tier (observability.aotcache):
+                # dir, entry count, hit/store counters, and the counted
+                # load failures with their reasons — the satellite's
+                # "surfaced on /healthz build.jax_cache" contract
+                "aot": get_aot_cache().state(),
             }
 
     def cold_block(self) -> dict:
@@ -274,10 +344,10 @@ class ColdStartLedger:
             first = dict(self._first_dispatch) if self._first_dispatch else None
             hits, misses = self._jax_hits, self._jax_misses
             listener = self._listener_registered
-        outcome_counts: dict[str, int] = {}
-        for r in rows:
-            o = r["persistent_cache"]
-            outcome_counts[o] = outcome_counts.get(o, 0) + 1
+            # process-lifetime counters, NOT derived from the bounded
+            # rows: eviction must never bias the by_outcome the --cold
+            # hit-rate gate reads
+            outcome_counts = dict(self.outcome_counts)
         return {
             "enabled": True,
             "phases": phases,
@@ -310,6 +380,7 @@ class ColdStartLedger:
             self._jax_hits = 0
             self._jax_misses = 0
             self.executables = []
+            self.outcome_counts = {}
             self._first_dispatch = None
 
 

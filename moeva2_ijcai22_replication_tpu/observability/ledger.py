@@ -40,6 +40,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .aotcache import get_aot_cache
 from .coldstart import get_coldstart
 
 #: identity attrs pushed by an enclosing dispatch site (e.g. the serving
@@ -193,6 +194,13 @@ class LedgerEntry:
     partitions: int = 1
     sharding: dict | None = None
     collectives: dict | None = None
+    #: where the executable came from: "aot" = deserialized from the
+    #: persistent AOT cache (compile_s is the load wall-clock, no trace/
+    #: lower/compile happened); None = compiled in-process (possibly via
+    #: the jax persistent cache — the cold ledger's classification says
+    #: which). Only present in entry JSON when set, so default-config
+    #: entries stay byte-identical to the pre-AOT schema.
+    source: str | None = None
 
     def per_device(self) -> dict:
         """Whole-program cost split across devices (states-partitioned
@@ -255,6 +263,8 @@ class LedgerEntry:
             "memory": self.memory,
             **self.roofline(dispatches, run_s),
         }
+        if self.source is not None:
+            out["source"] = self.source
         if self.devices > 1:
             # mesh sub-block only on multi-device executables: per-device
             # cost split, sharding summary, collective census — keeping
@@ -298,6 +308,7 @@ class CostLedger:
         memory: dict | None,
         aot: bool = True,
         mesh_probe: dict | None = None,
+        source: str | None = None,
     ) -> LedgerEntry | None:
         """Register a freshly compiled executable; returns its entry (None
         when the ledger is disabled — the compile itself already happened
@@ -327,6 +338,7 @@ class CostLedger:
                 partitions=int((mesh_probe or {}).get("partitions") or 1),
                 sharding=(mesh_probe or {}).get("sharding"),
                 collectives=(mesh_probe or {}).get("collectives"),
+                source=source,
             )
             self._entries[key] = entry
             if cause is not None:
@@ -686,13 +698,57 @@ class LedgeredJit:
         except Exception:
             return None
 
-    def _compile(self, args, kwargs):
+    def _compile(self, args, kwargs, key=None):
         import jax
 
         coldstart = get_coldstart()
-        # pre-compile snapshot for the persistent-cache classification
-        # (jax monitoring counters + cache-dir entry count)
+        # serialized-executable tier (observability.aotcache): a hit
+        # deserializes the finished binary and skips trace+lower+compile
+        # entirely — the fastest possible cold path. Key derivation and
+        # loading are best-effort: any failure (unkeyable identity,
+        # corrupt/stale/foreign entry — each a counted
+        # ``aot_cache_load_failures`` event inside the cache) falls
+        # through to the normal compile below, which then refreshes the
+        # entry.
+        aot_cache = get_aot_cache()
+        # ONE pre-compile snapshot serves both the AOT-hit note and the
+        # fall-through compile classification: nothing between the AOT
+        # load attempt and the compile touches the jax cache, and the
+        # probe's directory scan is per-compile I/O worth not doubling
+        # on a ~400-executable cold start
         probe = coldstart.compile_probe()
+        aot_key = None
+        if aot_cache.enabled and key is not None:
+            try:
+                aot_key = aot_cache.cache_key(
+                    self.producer, self._base_identity(args, kwargs), key
+                )
+            except Exception:
+                aot_key = None
+        if aot_key is not None:
+            t0 = time.perf_counter()
+            loaded = aot_cache.load(aot_key)
+            if loaded is not None:
+                load_s = time.perf_counter() - t0
+                entry = self._ledger.record_compile(
+                    producer=self.producer,
+                    identity=self._full_identity(args, kwargs),
+                    backend=jax.default_backend(),
+                    compile_s=load_s,
+                    cost=probe_cost_analysis(loaded),
+                    memory=probe_memory_analysis(loaded),
+                    mesh_probe=self._mesh_probe(loaded, None),
+                    source="aot",
+                )
+                coldstart.note_compile(
+                    producer=self.producer,
+                    key=entry.key if entry is not None else None,
+                    lower_s=0.0,
+                    compile_s=load_s,
+                    probe=probe,
+                    aot_cache="hit",
+                )
+                return (loaded, entry, load_s)
         t0 = time.perf_counter()
         try:
             lowered = self._jitted.lower(*args, **kwargs)
@@ -721,6 +777,23 @@ class LedgeredJit:
             )
             return (None, entry, compile_s)
         compile_s = time.perf_counter() - t0
+        # serialize the finished executable for the NEXT process (atomic,
+        # best-effort — a failed store degrades to plain compiles); done
+        # before the cold-start classification so the entry reads
+        # ``aot_stored``. REAL compiles only: an executable that
+        # ``lower().compile()`` satisfied from the jax persistent cache
+        # serializes into a blob that fails cross-process deserialization
+        # ("Symbols not found", observed on CPU PJRT / jax 0.4.37) — and
+        # the next process would load it from the jax cache anyway, so
+        # skipping loses nothing. Detection is the monitoring-counter
+        # delta (best-effort; the load path's counted-failure +
+        # self-healing discard backstops an undetected bad store).
+        stored = (
+            aot_cache.store(aot_key, compiled, producer=self.producer)
+            if aot_key is not None
+            and not coldstart.saw_cache_hit_since(probe)
+            else False
+        )
         entry = self._ledger.record_compile(
             producer=self.producer,
             identity=self._full_identity(args, kwargs),
@@ -739,10 +812,15 @@ class LedgeredJit:
             lower_s=lower_s,
             compile_s=max(compile_s - lower_s, 0.0),
             probe=probe,
+            aot_cache="stored" if stored else None,
         )
         return (compiled, entry, compile_s)
 
-    def _full_identity(self, args, kwargs) -> dict:
+    def _base_identity(self, args, kwargs) -> dict:
+        """Compile-time identity WITHOUT the ambient ledger context —
+        the AOT cache keys off this: context attrs (batch composition,
+        request ids) vary per dispatch and would fragment a disk key
+        that must be stable across processes."""
         ident = self._identity
         out = dict(ident() if callable(ident) else (ident or {}))
         if self._describe_args is not None:
@@ -750,6 +828,10 @@ class LedgeredJit:
                 out.update(self._describe_args(*args, **kwargs))
             except Exception:
                 pass
+        return out
+
+    def _full_identity(self, args, kwargs) -> dict:
+        out = self._base_identity(args, kwargs)
         ctx = _context.get()
         if ctx:
             out.update(ctx)
@@ -772,7 +854,7 @@ class LedgeredJit:
             with self._lock:
                 rec = self._compiled.get(key)
                 if rec is None:
-                    rec = self._compile(args, kwargs)
+                    rec = self._compile(args, kwargs, key)
                     self._compiled[key] = rec
                     compiled_now = True
                 else:

@@ -98,6 +98,8 @@ _HELP = {
     "coldstart_persistent_cache_misses": "Persistent-compilation-cache misses observed by jax monitoring in this process",
     "coldstart_cache_entries_added": "Entries this process added to the persistent compilation cache directory",
     "coldstart_time_to_first_dispatch_s": "Seconds from package import to the first compiled-program dispatch",
+    "coldstart_executables": "Executables classified by cold source (aot_hit, hit, aot_stored, miss_stored, miss_uncached, fallback, disabled, unknown)",
+    "coldstart_aot_load_failures": "Serialized-executable cache entries rejected at load (corrupt, fingerprint-stale, or undeserializable) — each fell back to a fresh compile",
 }
 
 
@@ -472,6 +474,22 @@ def _coldstart_lines(prefix: str, block: dict, lines: list[str]) -> None:
             n = _name(prefix, key, "_total" if mtype == "counter" else "")
             _family(lines, n, mtype, key)
             lines.append(f"{n} {_fmt(v)}")
+    by_outcome = cache.get("by_outcome") or {}
+    outcome_rows = [
+        (o, v) for o, v in sorted(by_outcome.items())
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    ]
+    if outcome_rows:
+        n = _name(prefix, "coldstart_executables", "_total")
+        _family(lines, n, "counter", "coldstart_executables")
+        for o, v in outcome_rows:
+            lines.append(f'{n}{{outcome="{_escape_label(o)}"}} {_fmt(v)}')
+    aot = cache.get("aot") or {}
+    fails = aot.get("load_failures")
+    if isinstance(fails, (int, float)) and not isinstance(fails, bool):
+        n = _name(prefix, "coldstart_aot_load_failures", "_total")
+        _family(lines, n, "counter", "coldstart_aot_load_failures")
+        lines.append(f"{n} {_fmt(fails)}")
     ttfd = block.get("time_to_first_dispatch_s")
     if isinstance(ttfd, (int, float)):
         n = _name(prefix, "coldstart_time_to_first_dispatch_s")

@@ -151,6 +151,14 @@ class _Resolved:
     n_features: int
     execution: dict
     meta: dict = field(default_factory=dict)
+    #: shape-only warmup closure: dispatches the engine with synthetic
+    #: rows at a given batch shape — same engine knobs as ``dispatch``,
+    #: so it compiles (or AOT-loads) the exact executable real traffic
+    #: at that bucket uses, but skips constraint validation and every
+    #: piece of request-path bookkeeping (SLO, capacity, quality).
+    #: NOT safe concurrently with live traffic (engines are
+    #: single-dispatch objects) — boot-time only.
+    prewarm: Callable[[np.ndarray], None] | None = None
 
 
 class AttackService:
@@ -224,6 +232,8 @@ class AttackService:
             start=start,
         )
         self._resolved: dict[tuple, _Resolved] = {}
+        #: boot-time warmup report (None until :meth:`prewarm` ran)
+        self._prewarm_report: dict | None = None
         # per-domain attack-quality aggregation (MoEvA dispatches): last
         # engine-judged sample + a dispatch count, computed host-side from
         # the already-fetched result objectives — zero device work
@@ -385,6 +395,17 @@ class AttackService:
                     )
                 return out
 
+            def prewarm_dispatch(x_batch: np.ndarray) -> None:
+                # shape-only warmup: ε/ε-step/budget are runtime scalars
+                # of the compiled program, so zero-rows at the bucket
+                # shape compile (or AOT-load) the identical executable
+                x_scaled = np.asarray(scaler.transform(x_batch))
+                y = np.asarray(surrogate.predict_proba(x_scaled)).argmax(-1)
+                engine.generate(
+                    x_scaled, y, eps=eps_run, eps_step=eps_step,
+                    max_iter=budget,
+                )
+
             chunk = None
         else:  # moeva
             from ..experiments.moeva import _cached_engine
@@ -467,6 +488,22 @@ class AttackService:
                     )
                 return out
 
+            def prewarm_dispatch(x_batch: np.ndarray) -> None:
+                # mirror the real dispatch's engine knobs exactly (they
+                # shape the segment schedule and therefore the compiled
+                # lengths); synthetic rows skip constraint validation —
+                # the executable depends on shapes, not values
+                engine.n_gen = budget
+                engine.seed = seed
+                engine.early_stop_check_every = early_stop
+                engine.early_stop_threshold = es_threshold
+                engine.early_stop_eps = es_eps
+                engine.compaction_buckets = self.menu.sizes
+                engine.record_quality = False
+                engine.quality_every = 0
+                engine.trace = None
+                engine.generate(x_batch, 1)
+
             chunk = engine.effective_states_chunk()
 
         mesh = engine.mesh
@@ -502,10 +539,86 @@ class AttackService:
                 "eps_step": eps_step if req.attack == "pgd" else None,
                 "budget": int(req.budget),
             },
+            prewarm=prewarm_dispatch,
         )
         with self._lock:
             self._resolved[key] = res
         return res
+
+    # -- prewarm -------------------------------------------------------------
+    def prewarm(self, specs: list[dict] | None = None, buckets=None) -> dict:
+        """Load the bucket menu's executables BEFORE the first request
+        lands (``tools/serve.py --prewarm`` / config ``serving.prewarm``):
+        for each spec — default: one plain-PGD ``flip`` program per served
+        domain — dispatch a shape-only warmup at every menu size, so the
+        replica's executables come out of the persistent AOT cache (or
+        compile once and land in it) at boot instead of on the first
+        caller's clock. The elapsed wall minus the compile/load seconds
+        the cold ledger booked is recorded as its ``device_warmup`` phase;
+        the report (executables, aot hit/store deltas) lands on /healthz
+        ``prewarm``. Boot-time only: engines are single-dispatch objects,
+        so this must not run concurrently with live traffic.
+
+        A spec is ``{"domain", "attack", "loss_evaluation", "eps",
+        "budget", "params"}`` (all but ``domain`` optional) — config
+        ``serving.prewarm`` accepts ``true`` (the default specs) or a
+        list of such dicts."""
+        from ..observability import get_aot_cache
+
+        cs = get_coldstart()
+        if specs is None:
+            specs = [
+                {"domain": d, "attack": "pgd", "loss_evaluation": "flip"}
+                for d in sorted(self.domains)
+            ]
+        sizes = [int(b) for b in (buckets or self.menu.sizes)]
+        ledger0 = get_ledger().summary()
+        aot0 = get_aot_cache().state()
+        compile0 = cs.compile_phase_seconds()
+        t0 = time.perf_counter()
+        warmed = []
+        for spec in specs:
+            req = AttackRequest(
+                domain=spec["domain"],
+                x=np.zeros((1, 1)),  # resolve() never reads the rows
+                attack=spec.get("attack", "pgd"),
+                loss_evaluation=spec.get("loss_evaluation", "flip"),
+                eps=float(spec.get("eps", 0.1)),
+                budget=int(spec.get("budget", 8)),
+                params=spec.get("params"),
+            )
+            res = self.resolve(req)
+            for b in sizes:
+                res.prewarm(np.zeros((b, res.n_features)))
+            warmed.append(
+                {
+                    "domain": req.domain,
+                    "attack": req.attack,
+                    "loss_evaluation": req.loss_evaluation,
+                    "buckets": sizes,
+                }
+            )
+        elapsed = time.perf_counter() - t0
+        # the warmup wall minus the compile/load seconds note_compile
+        # already booked IS the device_warmup phase (the phases must
+        # decompose the cold wall, not double-count it — same arithmetic
+        # as bench.py's serving warmup loop)
+        cs.record_phase(
+            "device_warmup",
+            max(elapsed - (cs.compile_phase_seconds() - compile0), 0.0),
+        )
+        summary = get_ledger().summary()
+        aot1 = get_aot_cache().state()
+        report = {
+            "seconds": round(elapsed, 3),
+            "specs": warmed,
+            "executables": summary["executables"] - ledger0["executables"],
+            "aot_hits": (aot1.get("hits") or 0) - (aot0.get("hits") or 0),
+            "aot_stored": (aot1.get("stores") or 0) - (aot0.get("stores") or 0),
+        }
+        with self._lock:
+            self._prewarm_report = report
+        return report
 
     def _note_device_run(
         self, domain: str, strategy: str, budget: int, engine, traced: int,
@@ -753,6 +866,10 @@ class AttackService:
         cache_keys = (
             "dir", "enabled", "error",
             "entries_start", "entries_now", "entries_added",
+            # the serialized-executable tier (counters + counted load
+            # failures) rides the same health surface — the aot-cache
+            # degradation satellite's contract
+            "aot",
         )
         jax_cache = (
             {k: cold["persistent_cache"].get(k) for k in cache_keys}
@@ -795,6 +912,10 @@ class AttackService:
             # host stages the idle attributes to — the replica-level
             # answer to "is the device waiting on the host?"
             "gaps": get_gap_tracker().snapshot(),
+            # boot-time prewarm report (None = no prewarm ran): how many
+            # executables the replica loaded before taking traffic, and
+            # how many came out of the persistent AOT cache vs compiled
+            "prewarm": self._prewarm_report,
             # replica warmup report: the startup-phase decomposition
             # (import, artifact builds, lower-vs-compile split,
             # per-executable persistent-cache hits/misses, time to first

@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Hermetic tests: the persistent AOT executable cache must not leak state
+# between test sessions (an AOT hit legitimately skips tracing, which
+# would flip trace-count assertions depending on what a previous run left
+# in .jax_cache/aot). Tests that exercise the cache configure it directly
+# (tests/test_aot_cache.py) or strip this var from a subprocess env.
+os.environ.setdefault("MOEVA2_AOT_CACHE_DISABLE", "1")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,219 @@
+"""Generation double-buffering (Moeva2.double_buffer) + the packed gate.
+
+The round-10 tentpole's second front: each gate's host-side tail (packed
+quality-stats scatter, parked-population fetch + merge, progress events)
+defers until the next segment is already enqueued, so it overlaps that
+segment's device execution. Contracts pinned here, tier-1:
+
+- double-buffered == serial, bit-identically, in strict-quality AND
+  early-exit modes (chunked too) — the schedule never touches device
+  programs, dispatch order, decisions, or RNG;
+- zero extra compiles and zero extra dispatches between the modes;
+- the deferral actually happens (``last_deferred_gate_flushes`` — the
+  structural witness that host gate work ran after a newer dispatch was
+  enqueued, i.e. the stages PR-9's ``top_gap_stages`` named moved off
+  the device's critical path);
+- the gate is ONE packed (S, 9) fetch whose o7 column is the success
+  mask (the former mask fetch + stats fetch were two round trips).
+"""
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+from moeva2_ijcai22_replication_tpu.attacks.objective import (
+    engine_quality_stats,
+)
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import (
+    synth_lcld,
+    synth_lcld_schema,
+)
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+from moeva2_ijcai22_replication_tpu.observability import (
+    Trace,
+    TraceRecorder,
+    get_gap_tracker,
+)
+
+
+@pytest.fixture(scope="module")
+def problem(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dbuf")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(12, cons.schema, seed=3)
+    cons.check_constraints_error(x)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=7))
+    return {
+        "constraints": cons,
+        "surrogate": sur,
+        "scaler": fit_minmax(x.min(0), x.max(0)),
+        "x": x,
+    }
+
+
+def _engine(problem, **kw):
+    kw.setdefault("n_gen", 11)
+    kw.setdefault("n_pop", 16)
+    kw.setdefault("n_offsprings", 8)
+    kw.setdefault("seed", 5)
+    kw.setdefault("archive_size", 4)
+    return Moeva2(
+        classifier=problem["surrogate"],
+        constraints=problem["constraints"],
+        ml_scaler=problem["scaler"],
+        norm=2,
+        **kw,
+    )
+
+
+def _run_both(problem, **kw):
+    out = {}
+    for db in (True, False):
+        eng = _engine(problem, double_buffer=db, **kw)
+        res = eng.generate(problem["x"], 1)
+        out[db] = (eng, res)
+    return out
+
+
+def _assert_bit_identical(res_a, res_b):
+    np.testing.assert_array_equal(res_a.x_gen, res_b.x_gen)
+    np.testing.assert_array_equal(res_a.f, res_b.f)
+    np.testing.assert_array_equal(res_a.x_ml, res_b.x_ml)
+    assert res_a.gens_executed == res_b.gens_executed
+
+
+class TestBitIdentity:
+    def test_early_exit_matches_serial(self, problem):
+        runs = _run_both(
+            problem, early_stop_check_every=2, compaction_buckets=(2, 4, 8, 16)
+        )
+        (eng_db, res_db), (eng_ser, res_ser) = runs[True], runs[False]
+        _assert_bit_identical(res_db, res_ser)
+        assert res_db.early_stop["compaction"] == res_ser.early_stop["compaction"]
+        # zero extra compiles AND zero extra dispatches across the modes
+        for name in ("_jit_init", "_jit_segment", "_jit_success"):
+            assert (
+                getattr(eng_db, name).calls == getattr(eng_ser, name).calls
+            ), name
+            assert len(getattr(eng_db, name)._compiled) == len(
+                getattr(eng_ser, name)._compiled
+            ), name
+
+    def test_strict_quality_matches_serial(self, problem):
+        runs = _run_both(
+            problem, record_quality=True, quality_every=3, seed=9
+        )
+        (_, res_db), (_, res_ser) = runs[True], runs[False]
+        _assert_bit_identical(res_db, res_ser)
+        assert [s["gen"] for s in res_db.quality["samples"]] == [
+            s["gen"] for s in res_ser.quality["samples"]
+        ]
+        for s_db, s_ser in zip(
+            res_db.quality["samples"], res_ser.quality["samples"]
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(s_db["per_state"]), np.asarray(s_ser["per_state"])
+            )
+
+    def test_chunked_early_exit_matches_serial(self, problem):
+        runs = _run_both(
+            problem,
+            early_stop_check_every=2,
+            compaction_buckets=(2, 4, 8),
+            max_states_per_call=8,
+            record_quality=True,
+            seed=11,
+        )
+        (_, res_db), (_, res_ser) = runs[True], runs[False]
+        _assert_bit_identical(res_db, res_ser)
+        assert res_db.early_stop == res_ser.early_stop
+
+
+class TestDeferral:
+    def test_double_buffer_defers_gate_flushes(self, problem):
+        """The structural witness: with double-buffering, at least one
+        gate's host tail ran after a NEWER dispatch was enqueued (the
+        overlap); serially, never. Deterministic — host ordering, not
+        timing."""
+        runs = _run_both(
+            problem, early_stop_check_every=2, compaction_buckets=(2, 4, 8, 16),
+            seed=13,
+        )
+        assert runs[True][0].last_deferred_gate_flushes > 0
+        assert runs[False][0].last_deferred_gate_flushes == 0
+
+    def test_strict_quality_gates_also_defer(self, problem):
+        runs = _run_both(
+            problem, record_quality=True, quality_every=2, seed=15
+        )
+        assert runs[True][0].last_deferred_gate_flushes > 0
+        assert runs[False][0].last_deferred_gate_flushes == 0
+
+    def test_gate_events_and_windows_survive_deferral(self, problem):
+        """Deferred emission changes WHEN the gate events land, never
+        whether: the trace still carries every moeva.gate event and the
+        gap tracker still lands the run's window."""
+        tracker = get_gap_tracker()
+        mark = tracker.mark()
+        rec = TraceRecorder(spans_enabled=True)
+        eng = _engine(
+            problem, early_stop_check_every=2,
+            compaction_buckets=(2, 4, 8, 16), seed=17,
+        )
+        eng.trace = Trace(rec, trace_id="dbuf-test")
+        res = eng.generate(problem["x"], 1)
+        gates = [
+            e for e in rec.events()
+            if e.get("kind") == "event" and e.get("name") == "moeva.gate"
+        ]
+        assert gates, "gate events must survive deferral"
+        # every compaction-trace entry has a matching emitted event, in
+        # gate order, with the payload intact
+        gens = [g["attrs"]["gen"] for g in gates]
+        assert gens == sorted(gens)
+        assert set(
+            t["gen"] for t in res.early_stop["compaction"]
+        ) <= set(gens)
+        assert all("success_frac" in g["attrs"] for g in gates)
+        block = tracker.gaps_block(since=mark)
+        assert block["windows"] == 1
+        # the deferred host tail emits its spans too (parked_merge or
+        # gate_fetch present for the join to attribute gaps against)
+        span_names = {
+            e.get("name") for e in rec.events() if e.get("kind") == "span"
+        }
+        assert "gate_fetch" in span_names
+
+
+class TestPackedGate:
+    def test_gate_is_one_packed_stats_array(self, problem):
+        """The gate program returns the (S, 9) stats alone; the success
+        mask is its o7 column, derived host-side — one fetch per gate."""
+        import jax.numpy as jnp
+
+        eng = _engine(problem)
+        pop_f = jnp.asarray(
+            np.array(
+                [
+                    # [misclass prob, distance, sum violations]
+                    [[0.1, 0.05, 0.0]],  # success: misclassified + feasible
+                    [[0.9, 0.05, 0.0]],  # not misclassified
+                ],
+                np.float32,
+            )
+        )
+        arch_f = jnp.zeros((2, 0, 3), np.float32)
+        carry = (None, pop_f, None, arch_f, None, None)
+        stats = np.asarray(eng._success_mask(carry))
+        assert stats.shape == (2, 9)
+        succ = stats[..., 6] > 0
+        ref = engine_quality_stats(
+            np.asarray(pop_f, np.float64), 0.5, np.inf, xp=np
+        )
+        np.testing.assert_array_equal(succ, ref[..., 6] > 0)
+        assert succ.tolist() == [True, False]

@@ -718,6 +718,18 @@ class TestPromExposition:
 # ---------------------------------------------------------------------------
 
 
+def _interior(o2_100=0.20, o7_100=0.08, o2_300=0.95, o7_300=0.08):
+    """Quality interior block mirroring the committed r06 values within
+    the drift threshold — a synthetic NEXT record appended after r06 must
+    stay comparable on every metric r06 armed (see the committed-series
+    tests)."""
+    mk = lambda o2, o7: [1.0, o2, 1.0, o7, 1.0, o7, o7]  # noqa: E731
+    return {
+        "100": {"gen": 100, "o_rates": mk(o2_100, o7_100)},
+        "300": {"gen": 300, "o_rates": mk(o2_300, o7_300)},
+    }
+
+
 def _orecord(steady=10.0, overlap=0.9, cold_ratio=1.2, with_gaps=True):
     rec = {
         "metric": "m",
@@ -726,8 +738,39 @@ def _orecord(steady=10.0, overlap=0.9, cold_ratio=1.2, with_gaps=True):
         "cold_s": steady * cold_ratio,
         "execution": {"n_states": 1000, "n_gen": 1000},
         "telemetry": {
-            "cost": {"flops_total": 1e12},
-            "quality": {"enabled": False},
+            "cost": {"flops_total": 2.51e15},
+            "quality": {
+                "judged": "engine",
+                "samples": 10,
+                "curve": [],
+                "interior": _interior(),
+            },
+        },
+        # the r06-armed blocks a successor must keep carrying: botnet
+        # quality (always-on gate) and the serving slo block (--slo)
+        "real_botnet": {
+            "steady_s": 21.0,
+            "n_states": 387,
+            "n_gen": 1000,
+            "quality": {
+                "judged": "engine",
+                "samples": 4,
+                "curve": [],
+                "interior": _interior(0.199, 0.08, 0.632, 0.245),
+            },
+        },
+        "serving": {
+            "levels": [
+                {"offered_rps": 16.0, "throughput_rps": 16.0, "p99_ms": 20.0},
+                {"offered_rps": 64.0, "throughput_rps": 62.0, "p99_ms": 24.0},
+            ],
+            "telemetry": {
+                "slo": {
+                    "stages": {},
+                    "shed": {"total": 0, "by_domain": {}},
+                    "knee": {"knee_rps": 64.0, "first_saturated_rps": None},
+                }
+            },
         },
     }
     if with_gaps:
@@ -742,7 +785,11 @@ def _orecord(steady=10.0, overlap=0.9, cold_ratio=1.2, with_gaps=True):
         rec["cold"] = {
             "enabled": True,
             "phases": {"xla_compile": 2.0},
-            "persistent_cache": {"hits": 4, "misses": 2},
+            "persistent_cache": {
+                "hits": 4,
+                "misses": 2,
+                "by_outcome": {"aot_hit": 9, "hit": 1, "miss_stored": 2},
+            },
             "time_to_first_dispatch_s": 3.0,
         }
     return rec
@@ -843,7 +890,9 @@ class TestBenchDiffOverlap:
         series = sorted(str(p) for p in tmp_path.glob("BENCH_r*.json"))
         assert nxt in series
         assert (
-            bench_diff.main(["--check", "--slo", "--mesh", "--overlap", *series])
+            bench_diff.main(
+                ["--check", "--slo", "--mesh", "--overlap", "--cold", *series]
+            )
             == 0
         )
 

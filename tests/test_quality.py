@@ -586,7 +586,13 @@ def _qrecord(
             "steady_s": 5.0, "n_states": 387, "n_gen": 1000,
             "quality": {
                 "judged": "engine", "samples": 2, "curve": [],
-                "interior": {"100": dict(mk(*botnet), gen=100)},
+                # both interior budgets: the committed r06 botnet block
+                # carries @100 AND @300, and a successor must keep every
+                # armed metric (absent-in-latest fails as capture loss)
+                "interior": {
+                    "100": dict(mk(*botnet), gen=100),
+                    "300": dict(mk(0.632, 0.245), gen=300),
+                },
             },
         }
     return rec
@@ -707,7 +713,15 @@ class TestBenchDiffQuality:
             shutil.copy(p, tmp_path / os.path.basename(p))
         nxt = _write(
             tmp_path, "BENCH_r99.json",
-            {"n": 99, "rc": 0, "parsed": _qrecord(steady=9.0, value=80.0)},
+            {
+                "n": 99,
+                "rc": 0,
+                # botnet quality included: r06 armed that block, and a
+                # successor dropping it would fail as capture loss
+                "parsed": _qrecord(
+                    steady=9.0, value=80.0, botnet=(0.199, 0.080)
+                ),
+            },
         )
         series = sorted(str(p) for p in tmp_path.glob("BENCH_r*.json"))
         assert nxt in series

@@ -1117,6 +1117,28 @@ class TestBenchDiffSlo:
             shutil.copy(p, tmp_path / os.path.basename(p))
         rec = _srecord(steady=9.0)
         rec["value"] = 80.0
+        # quality blocks mirroring the committed r06 values: the quality
+        # gate is always-on, so a successor record appended after r06
+        # must keep carrying the interior rates r06 armed (headline AND
+        # real_botnet) or it fails as capture loss
+        mk = lambda o2, o7: [1.0, o2, 1.0, o7, 1.0, o7, o7]  # noqa: E731
+        rec["telemetry"]["quality"] = {
+            "judged": "engine", "samples": 10, "curve": [],
+            "interior": {
+                "100": {"gen": 100, "o_rates": mk(0.20, 0.08)},
+                "300": {"gen": 300, "o_rates": mk(0.95, 0.08)},
+            },
+        }
+        rec["real_botnet"] = {
+            "steady_s": 21.0, "n_states": 387, "n_gen": 1000,
+            "quality": {
+                "judged": "engine", "samples": 4, "curve": [],
+                "interior": {
+                    "100": {"gen": 100, "o_rates": mk(0.199, 0.08)},
+                    "300": {"gen": 300, "o_rates": mk(0.632, 0.245)},
+                },
+            },
+        }
         nxt = _write(
             tmp_path, "BENCH_r99.json", {"n": 99, "rc": 0, "parsed": rec}
         )

@@ -83,6 +83,19 @@ Baseline-skip semantics match ``--slo``/``--mesh``: pre-gap records
 capture while any baseline carries it fails — the gate must not be
 disarmable by dropping the measurement.
 
+Cold metrics (``--cold``): the cold-start gate the AOT-cache PR armed.
+Two rules: (a) ABSOLUTE — the latest record's ``cold_steady_ratio``
+(when it carries the structured ``cold`` breakdown) must not exceed
+``--cold-max-ratio`` (default 1.2, ROADMAP item 2's exit criterion);
+unlike every other gate this needs no baseline, because the criterion is
+a target, not a trajectory. (b) RELATIVE — the warm-start hit rate,
+``(hit + aot_hit) / classified executables`` from the cold breakdown's
+``persistent_cache.by_outcome``, must not drop more than
+``--overlap-threshold`` vs the best baseline exposing one (a replica
+that silently stopped finding its caches cold-starts every process).
+Pre-cold records skip as baselines; capture loss of the cold breakdown
+itself is already non-disarmable under ``--overlap``.
+
 Records may be bare bench JSON or the committed driver wrapper
 ``{"n", "cmd", "rc", "parsed"}``; wrappers with a non-zero rc or an
 empty payload are skipped (a crashed bench is not evidence of a
@@ -381,6 +394,32 @@ def _overlap_points(rec: dict) -> dict[str, tuple[float, bool]]:
     return out
 
 
+#: latest-record cold/steady ceiling enforced under --cold (ROADMAP item
+#: 2's exit criterion: a process must come up within 20% of steady).
+DEFAULT_COLD_MAX_RATIO = 1.2
+
+
+def _cold_hit_rate(rec: dict) -> float | None:
+    """Warm-start hit rate of a record's cold breakdown: the fraction of
+    classified executables that loaded from a persistent tier (jax-cache
+    ``hit`` or serialized-executable ``aot_hit``) instead of compiling.
+    None for records without a capture-on cold breakdown or with nothing
+    classified."""
+    cold = rec.get("cold")
+    if not isinstance(cold, dict) or cold.get("enabled") is False:
+        return None
+    by_outcome = (cold.get("persistent_cache") or {}).get("by_outcome")
+    if not isinstance(by_outcome, dict):
+        return None
+    total = sum(
+        int(v) for v in by_outcome.values() if isinstance(v, (int, float))
+    )
+    if total <= 0:
+        return None
+    hits = int(by_outcome.get("hit", 0)) + int(by_outcome.get("aot_hit", 0))
+    return hits / total
+
+
 def diff_series(
     records: list[tuple[str, dict]],
     threshold: float,
@@ -391,6 +430,8 @@ def diff_series(
     mesh_threshold: float = DEFAULT_MESH_THRESHOLD,
     overlap: bool = False,
     overlap_threshold: float = DEFAULT_OVERLAP_THRESHOLD,
+    cold: bool = False,
+    cold_max_ratio: float = DEFAULT_COLD_MAX_RATIO,
 ) -> tuple[list[str], bool, list[dict]]:
     """Compare the last record pairwise against every earlier one, each
     pair in the strongest normalization basis BOTH sides support (ledger
@@ -855,6 +896,84 @@ def diff_series(
                     "verdict": "regression" if bad else "ok",
                 }
             )
+    # -- cold: absolute cold/steady ceiling + warm-start hit rate ---------
+    if cold:
+        cold_block = latest.get("cold")
+        has_cold = (
+            isinstance(cold_block, dict)
+            and cold_block.get("enabled") is not False
+        )
+        csr = latest.get("cold_steady_ratio")
+        if has_cold and isinstance(csr, (int, float)):
+            # absolute gate: the exit criterion is a target, not a
+            # trajectory — no baseline needed
+            bad = csr > cold_max_ratio
+            regressed |= bad
+            lines.append(
+                f"  cold_steady_ratio (absolute): {csr:.3f} vs ceiling "
+                f"{cold_max_ratio:g}"
+                + ("  ** REGRESSION **" if bad else "")
+            )
+            entries.append(
+                {
+                    "metric": "cold_steady_ratio (absolute)",
+                    "kind": "cold",
+                    "basis": "absolute",
+                    "ceiling": cold_max_ratio,
+                    "new": float(csr),
+                    "verdict": "regression" if bad else "ok",
+                }
+            )
+        else:
+            lines.append(
+                "  cold (absolute): latest record carries no structured "
+                "cold breakdown — skipped (capture loss is --overlap's "
+                "business)"
+            )
+            entries.append(
+                {"metric": "cold", "verdict": "skipped", "reason": "absent"}
+            )
+        new_rate = _cold_hit_rate(latest)
+        old_rates = [
+            (path, r)
+            for path, rec in earlier
+            if (r := _cold_hit_rate(rec)) is not None
+        ]
+        if new_rate is not None and old_rates:
+            path, old_v = max(old_rates, key=lambda t: t[1])
+            rel = (old_v - new_rate) / old_v if old_v > 0 else 0.0
+            bad = rel > overlap_threshold
+            regressed |= bad
+            direction = "worse" if rel > 0 else "better"
+            lines.append(
+                f"  cold.warm_start_hit_rate: {new_rate:.4f} vs best "
+                f"{old_v:.4f} ({path}) [cold] -> {abs(rel) * 100:.1f}% "
+                f"{direction}" + ("  ** REGRESSION **" if bad else "")
+            )
+            entries.append(
+                {
+                    "metric": "cold.warm_start_hit_rate",
+                    "kind": "cold",
+                    "basis": "relative",
+                    "baseline": path,
+                    "old": old_v,
+                    "new": new_rate,
+                    "delta_rel": rel,
+                    "verdict": "regression" if bad else "ok",
+                }
+            )
+        elif new_rate is not None:
+            lines.append(
+                "  cold.warm_start_hit_rate: no comparable earlier record "
+                "— skipped"
+            )
+            entries.append(
+                {
+                    "metric": "cold.warm_start_hit_rate",
+                    "verdict": "skipped",
+                    "reason": "no_baseline",
+                }
+            )
     return lines, regressed, entries
 
 
@@ -933,6 +1052,24 @@ def main(argv=None) -> int:
         f"(default {DEFAULT_OVERLAP_THRESHOLD})",
     )
     parser.add_argument(
+        "--cold",
+        action="store_true",
+        help="also gate the cold-start metrics: the latest record's "
+        "cold_steady_ratio against an ABSOLUTE ceiling "
+        "(--cold-max-ratio; the ROADMAP exit criterion needs no "
+        "baseline) and the warm-start hit rate "
+        "((hit + aot_hit) / classified executables) against the best "
+        "baseline (relative, --overlap-threshold). Pre-cold records "
+        "skip as baselines",
+    )
+    parser.add_argument(
+        "--cold-max-ratio",
+        type=float,
+        default=DEFAULT_COLD_MAX_RATIO,
+        help="absolute cold/steady ceiling enforced under --cold "
+        f"(default {DEFAULT_COLD_MAX_RATIO})",
+    )
+    parser.add_argument(
         "--json",
         action="store_true",
         help="append one machine-readable JSON line (per-metric basis, "
@@ -985,6 +1122,8 @@ def main(argv=None) -> int:
         mesh_threshold=args.mesh_threshold,
         overlap=args.overlap,
         overlap_threshold=args.overlap_threshold,
+        cold=args.cold,
+        cold_max_ratio=args.cold_max_ratio,
     )
     print("\n".join(lines))
     if regressed:
@@ -1007,6 +1146,8 @@ def main(argv=None) -> int:
                     "mesh_threshold": args.mesh_threshold,
                     "overlap": args.overlap,
                     "overlap_threshold": args.overlap_threshold,
+                    "cold": args.cold,
+                    "cold_max_ratio": args.cold_max_ratio,
                     "regressed": regressed,
                     "metrics": entries,
                 }

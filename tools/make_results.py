@@ -92,6 +92,27 @@ def cold_ratio(d):
     return f"{t / (t - compile_s):.2f}x"
 
 
+def aot_sources(d):
+    """Where this run's executables came from: ``k/n`` = k of the run's
+    n ledgered executables were deserialized from the persistent AOT
+    cache (entry ``source: "aot"`` — trace, lower, and compile all
+    skipped); ``0/n`` = the run compiled everything (cold, or the AOT
+    tier was off — pre-round-10 metrics and disabled-cache runs read the
+    same, honestly); ``—`` when the run has no ledgered executables
+    (warm engine, zero compiles this run). A record whose entries list
+    was bounded (bench's ``bound_record`` adds ``entries_omitted``)
+    counts the omitted rows in the denominator and marks the numerator
+    as a lower bound (``k+/n``) — the capped list cannot say where the
+    dropped executables came from."""
+    cost = (d.get("telemetry") or {}).get("cost") or {}
+    entries = cost.get("entries")
+    if not isinstance(entries, list) or not entries:
+        return "—"
+    n_aot = sum(1 for e in entries if e.get("source") == "aot")
+    omitted = cost.get("entries_omitted") or 0
+    return f"{n_aot}{'+' if omitted else ''}/{len(entries) + omitted}"
+
+
 def interior_rate(d, budget):
     """Engine-judged interior o2/o7 at ``budget`` generation steps from the
     metrics' ``telemetry.quality.interior`` block (post-PR-6 runs with
@@ -123,6 +144,7 @@ def rows_for(path):
             "int300": interior_rate(d, 300),
             "overlap": overlap_ratio(d),
             "coldx": cold_ratio(d),
+            "aot": aot_sources(d),
             "file": os.path.relpath(f, ROOT),
         }
         if "objectives_list" in d:  # moeva: one row per eps
@@ -172,6 +194,11 @@ def main():
     print("idle) and `cold×` the run's cold multiplier (compile-inclusive wall")
     print("over run-only wall, from the cost ledger's compile seconds); `—` for")
     print("warm runs and metrics predating the dispatch-gap ledger (pre-round-9).")
+    print("`aot` is the run's cold-source split: k/n of its ledgered executables")
+    print("were deserialized from the persistent AOT cache (`telemetry.cost`")
+    print("entries with `source: \"aot\"` — trace+lower+compile all skipped);")
+    print("0/n runs compiled everything (AOT tier off or a truly cold cache,")
+    print("including all pre-round-10 metrics); `—` = zero compiles this run.")
     print()
     print("Grid points ABSENT from a table failed the evaluator's scaled-range")
     print("assert (`objective_calculator.py:72-76` parity: candidates outside the")
@@ -191,11 +218,11 @@ def main():
         print(
             "| attack | scenario/model | budget | ε "
             "| o1 | o2 | o3 | o4 | o5 | o6 | o7 | time (s) | cmp "
-            "| compile (s) | o@100 | o@300 | overlap | cold× |"
+            "| compile (s) | o@100 | o@300 | overlap | cold× | aot |"
         )
         print(
             "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---"
-            "|---|---|---|"
+            "|---|---|---|---|"
         )
         for r in sorted(
             rows, key=lambda r: (r["attack"], r["model"], r["budget"] or 0, str(r["eps"]))
@@ -204,7 +231,8 @@ def main():
             print(
                 f"| {r['attack']} | {r['model']} | {r['budget']} | {r['eps']} "
                 f"| {cells} | {r['time_s']} | {r['compile']} | {r['compile_s']} "
-                f"| {r['int100']} | {r['int300']} | {r['overlap']} | {r['coldx']} |"
+                f"| {r['int100']} | {r['int300']} | {r['overlap']} | {r['coldx']} "
+                f"| {r['aot']} |"
             )
     print()
 

@@ -16,11 +16,14 @@ Gates (each runs as a subprocess of the same interpreter, so a gate that
 initializes JAX — shard_lint builds the emulated 8-device mesh — cannot
 pollute another gate's process state):
 
-- ``bench_diff`` — the perf+quality+SLO+mesh+overlap watchdog over the
-  committed ``BENCH_r*.json`` series (``tools/bench_diff.py --check
-  --slo --mesh --overlap``): wall-clock regressions (ledger-normalized),
-  interior-success-rate drift, serving knee/p99, per-device balance +
-  hot-loop collectives, and the device overlap / cold-steady ratios.
+- ``bench_diff`` — the perf+quality+SLO+mesh+overlap+cold watchdog over
+  the committed ``BENCH_r*.json`` series (``tools/bench_diff.py --check
+  --slo --mesh --overlap --cold``): wall-clock regressions
+  (ledger-normalized), interior-success-rate drift, serving knee/p99,
+  per-device balance + hot-loop collectives, the device overlap /
+  cold-steady ratios, the ABSOLUTE cold/steady ceiling (1.2 — ROADMAP
+  item 2's exit criterion), and the warm-start hit rate
+  ((hit + aot_hit) / classified executables).
 - ``shard_lint`` — the states-sharding contract (``tools/shard_lint.py
   --check``): compiles the committed attack programs on the emulated
   8-device CPU mesh and fails on hot-loop float collectives, oversized
@@ -51,7 +54,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = {
     "bench_diff": (
         "bench_diff.py",
-        ["--check", "--slo", "--mesh", "--overlap"],
+        ["--check", "--slo", "--mesh", "--overlap", "--cold"],
     ),
     "shard_lint": ("shard_lint.py", ["--check"]),
 }

@@ -31,6 +31,14 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default=None, help="override serving.host")
     parser.add_argument("--port", type=int, default=None, help="override serving.port")
     parser.add_argument("-v", "--verbose", action="store_true", help="access log")
+    parser.add_argument(
+        "--prewarm",
+        action="store_true",
+        help="load the bucket menu's executables at boot, before the "
+        "first request lands (compile-or-AOT-load; also enabled by "
+        "config serving.prewarm — true for the per-domain defaults, or "
+        "a list of {domain, attack, loss_evaluation, budget} specs)",
+    )
     args = parser.parse_args(argv)
 
     from moeva2_ijcai22_replication_tpu.experiments.common import setup_jax_cache
@@ -65,6 +73,19 @@ def main(argv=None) -> int:
         slo_buckets=srv_cfg.get("slo_histogram_buckets"),
         capacity_window=srv_cfg.get("capacity_window", 256),
     )
+    # boot-time prewarm: BEFORE the HTTP front binds, so the first caller
+    # never pays a compile (engines are single-dispatch objects — this
+    # must not race live traffic)
+    prewarm_cfg = srv_cfg.get("prewarm")
+    if args.prewarm or prewarm_cfg:
+        specs = prewarm_cfg if isinstance(prewarm_cfg, list) else None
+        report = service.prewarm(specs)
+        print(
+            f"prewarm: {report['executables']} executables in "
+            f"{report['seconds']}s (aot hits {report['aot_hits']}, "
+            f"stored {report['aot_stored']})",
+            flush=True,
+        )
     host = args.host or srv_cfg.get("host", "127.0.0.1")
     port = args.port if args.port is not None else srv_cfg.get("port", 8787)
     httpd = serve(

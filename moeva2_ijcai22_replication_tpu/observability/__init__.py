@@ -99,6 +99,8 @@ from .slo import (
     Histogram,
     SloTracker,
     detect_knee,
+    merge_histogram_snapshots,
+    merge_slo_snapshots,
     slo_block,
     validate_slo,
 )
@@ -168,6 +170,8 @@ __all__ = [
     "ledger_context",
     "maybe_span",
     "merge_chunk_quality",
+    "merge_histogram_snapshots",
+    "merge_slo_snapshots",
     "mesh_block",
     "mesh_snapshot",
     "probe_collectives",

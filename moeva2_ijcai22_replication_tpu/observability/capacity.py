@@ -111,6 +111,30 @@ class CapacityModel:
                 )
             dq.append(obs)
 
+    # -- admission hints -----------------------------------------------------
+    def retry_after_s(
+        self, queued_rows: int, *, floor_s: float = 0.001, cap_s: float = 30.0
+    ) -> float | None:
+        """Honest ``Retry-After`` for a queue-full rejection: the predicted
+        seconds for ``queued_rows`` to drain at the windowed sustainable
+        row rate (per-domain ``rows / run_s``, summed — the same window
+        that backs ``max_sustainable_qps``). None when no capacity window
+        is live yet (the caller falls back to its static hint); clamped to
+        [``floor_s``, ``cap_s``] so a tiny backlog over a fast device never
+        advertises a zero and a mispredicted window never advertises
+        minutes."""
+        with self._lock:
+            per_domain = {d: list(dq) for d, dq in self._by_domain.items()}
+        rows_per_s = 0.0
+        for obs in per_domain.values():
+            run_s = sum(o.run_s for o in obs)
+            rows = sum(o.rows for o in obs)
+            if run_s > 0 and rows > 0:
+                rows_per_s += rows / run_s
+        if rows_per_s <= 0:
+            return None
+        return min(max(float(queued_rows) / rows_per_s, floor_s), cap_s)
+
     # -- export --------------------------------------------------------------
     def domain_block(self, domain: str) -> dict | None:
         """The per-domain capacity block /healthz publishes."""
@@ -195,6 +219,17 @@ class CapacityModel:
         return {
             "window_batches": len(obs),
             "window_limit": self.window,
+            # freshness: seconds since the window's LAST batch completed
+            # (this model's clock domain). A wedged replica keeps serving
+            # its old capacity numbers on /healthz forever — the router
+            # discounts any block whose age says the window no longer
+            # describes current traffic, instead of routing into it.
+            "age_s": round(self.clock() - obs[-1].t, 3),
+            # wall span the window covers (first dispatch start to last
+            # completion): age + span bound when the window's traffic ran
+            "window_span_s": round(
+                (obs[-1].t - obs[0].t) + obs[0].run_s, 3
+            ),
             "requests": requests,
             "rows": rows,
             "run_s": round(run_s, 6),

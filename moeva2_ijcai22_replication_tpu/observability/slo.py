@@ -282,6 +282,99 @@ class SloTracker:
         }
 
 
+def merge_histogram_snapshots(snaps: list[dict]) -> dict | None:
+    """Merge :meth:`Histogram.snapshot` views from N replicas into one.
+
+    This is what the fixed-bucket cumulative export exists for: same
+    bounds => cumulative counts add pointwise and the merged view is a
+    valid histogram snapshot of the union traffic. Quantiles are
+    re-estimated from the merged cumulative counts (bucket upper bound
+    containing the rank — conservative, like the per-replica export).
+    Returns None when the snapshots' bucket bounds disagree: merging
+    mismatched schemes would silently misbucket one replica's traffic,
+    so the caller skips (and counts) the family instead.
+    """
+    snaps = [s for s in snaps if isinstance(s, dict) and s.get("buckets")]
+    if not snaps:
+        return None
+    les = [b[0] for b in snaps[0]["buckets"]]
+    for s in snaps[1:]:
+        if [b[0] for b in s["buckets"]] != les:
+            return None
+    counts = [
+        sum(int(s["buckets"][i][1]) for s in snaps) for i in range(len(les))
+    ]
+    total = sum(int(s.get("count", 0)) for s in snaps)
+    out = {
+        "buckets": [[le, c] for le, c in zip(les, counts)],
+        "sum": round(sum(float(s.get("sum", 0.0)) for s in snaps), 6),
+        "count": total,
+        "p50": None,
+        "p99": None,
+        "n": total,
+    }
+    if total > 0:
+        for key, q in (("p50", 0.50), ("p99", 0.99)):
+            rank = q * total
+            for le, cum in out["buckets"]:
+                if cum >= rank:
+                    out[key] = le
+                    break
+    return out
+
+
+def merge_slo_snapshots(snaps: list[dict]) -> dict:
+    """Merge :meth:`SloTracker.snapshot` views from N replicas into one
+    fleet view — the router's /metrics aggregation.
+
+    Stage histograms merge bucket-wise per (domain, stage) via
+    :func:`merge_histogram_snapshots`; a family whose replicas disagree
+    on bucket bounds is dropped and counted in
+    ``skipped_mismatched_bounds`` (fixed shared bounds are the
+    mergeability contract — ``serving.slo_histogram_buckets`` must match
+    across a pooled fleet, and the build-identity check at adoption
+    enforces the config hash that carries it). Shed counters add.
+    """
+    snaps = [s for s in snaps if isinstance(s, dict)]
+    stages_in: dict[tuple[str, str], list[dict]] = {}
+    for s in snaps:
+        for domain, by_stage in (s.get("stages") or {}).items():
+            for stage, hist in (by_stage or {}).items():
+                stages_in.setdefault((domain, stage), []).append(hist)
+    stages: dict = {}
+    skipped = 0
+    for (domain, stage), hists in sorted(stages_in.items()):
+        merged = merge_histogram_snapshots(hists)
+        if merged is None:
+            skipped += 1
+            continue
+        stages.setdefault(domain, {})[stage] = merged
+    shed_by_domain: dict = {}
+    shed_total = 0
+    for s in snaps:
+        shed = s.get("shed") or {}
+        shed_total += int(shed.get("total", 0))
+        for domain, by_cause in (shed.get("by_domain") or {}).items():
+            for cause, by_stage in (by_cause or {}).items():
+                for stage, n in (by_stage or {}).items():
+                    tgt = shed_by_domain.setdefault(domain, {}).setdefault(
+                        cause, {}
+                    )
+                    tgt[stage] = tgt.get(stage, 0) + int(n)
+    bounds = next(
+        (list(s["bucket_bounds"]) for s in snaps if s.get("bucket_bounds")),
+        [],
+    )
+    return {
+        "enabled": any(s.get("enabled") for s in snaps),
+        "bucket_bounds": bounds,
+        "stages": stages,
+        "shed": {"total": shed_total, "by_domain": shed_by_domain},
+        "merged_from": len(snaps),
+        "skipped_mismatched_bounds": skipped,
+    }
+
+
 def detect_knee(
     levels,
     p99_factor: float = 3.0,

@@ -9,7 +9,10 @@ them through a shape-bucketed :class:`Microbatcher` — full fixed-shape
 device batches from variably-sized requests, one compiled program per
 (engine-static-config, bucket-size). ``serving.server`` is the stdlib-only
 JSON/HTTP front; ``serving.sweep`` is the offered-load harness behind
-``bench.py --serving``.
+``bench.py --serving``; ``serving.fleet`` scales the whole stack out —
+N replica processes sharing one AOT/artifact cache directory behind a
+capacity-driven router with add/drain lifecycle and a chaos-proof fleet
+sweep (``bench.py --fleet``).
 """
 
 from .batcher import (
@@ -20,6 +23,13 @@ from .batcher import (
     QueueFull,
     RequestTooLarge,
 )
+from .fleet import (
+    BuildMismatch,
+    ReplicaHandle,
+    ReplicaManager,
+    Router,
+    serve_router,
+)
 from .service import AttackRequest, AttackResponse, AttackService, InvalidRequest
 
 __all__ = [
@@ -28,9 +38,14 @@ __all__ = [
     "AttackService",
     "BatchExecutionError",
     "BucketMenu",
+    "BuildMismatch",
     "DeadlineExceeded",
     "InvalidRequest",
     "Microbatcher",
     "QueueFull",
+    "ReplicaHandle",
+    "ReplicaManager",
     "RequestTooLarge",
+    "Router",
+    "serve_router",
 ]

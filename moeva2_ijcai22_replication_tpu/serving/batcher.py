@@ -53,7 +53,14 @@ from ..observability import Trace, ledger_context, use_trace
 
 
 class QueueFull(Exception):
-    """Backpressure: the bounded request queue is full; retry later."""
+    """Backpressure: the bounded request queue is full; retry later.
+
+    ``retry_after_s`` is the 429 ``Retry-After`` hint. With a live
+    capacity window (``Microbatcher(retry_after_fn=...)`` — the service
+    wires :meth:`~..observability.capacity.CapacityModel.retry_after_s`)
+    it is the predicted drain time of the rows ahead of the caller,
+    floored by the flusher's next flush obligation; otherwise the static
+    ``max_delay_s`` fallback."""
 
     def __init__(self, msg: str, retry_after_s: float = 0.05):
         super().__init__(msg)
@@ -106,6 +113,7 @@ class Microbatcher:
         slo=None,
         clock: Callable[[], float] | None = None,
         start: bool = True,
+        retry_after_fn: Callable[[int], float | None] | None = None,
     ):
         import time
 
@@ -113,6 +121,13 @@ class Microbatcher:
         self.max_delay_s = float(max_delay_s)
         self.max_queue_rows = int(max_queue_rows)
         self.metrics = metrics
+        #: optional honest-backpressure hook: called with the queued row
+        #: count on a queue-full rejection, returns the predicted seconds
+        #: until that backlog drains (None = no live prediction, fall back
+        #: to ``max_delay_s``). The service wires the capacity model's
+        #: windowed drain rate here so 429 ``Retry-After`` reflects real
+        #: saturation instead of a constant.
+        self.retry_after_fn = retry_after_fn
         #: SLO tracker (``observability.slo.SloTracker`` or None): receives
         #: per-request stage latencies (queue_wait/batch_wait/dispatch) and
         #: shed attribution (expired/overrun/poisoned) keyed by the
@@ -180,10 +195,25 @@ class Microbatcher:
             if self._rows_total + n > self.max_queue_rows:
                 if self.metrics:
                     self.metrics.count("rejected")
+                hint = None
+                if self.retry_after_fn is not None:
+                    try:
+                        hint = self.retry_after_fn(self._rows_total)
+                    except Exception:  # noqa: BLE001 — a broken hint
+                        hint = None  # must not turn a 429 into a 500
+                if hint is None:
+                    hint = self.max_delay_s
+                else:
+                    # capacity predicts the DEVICE drain; admission also
+                    # waits for the flusher's next flush obligation — the
+                    # hint is honest only above both
+                    nd = self._next_deadline(now)
+                    if nd is not None:
+                        hint = max(hint, nd)
                 raise QueueFull(
                     f"queue full ({self._rows_total}/{self.max_queue_rows} "
-                    f"rows); retry after {self.max_delay_s:.3f}s",
-                    retry_after_s=self.max_delay_s,
+                    f"rows); retry after {hint:.3f}s",
+                    retry_after_s=hint,
                 )
             q = self._queues.get(key)
             if q is None:

@@ -1,0 +1,39 @@
+"""Fleet serving: N single-process replicas behind a capacity-driven router.
+
+The scale-out assembly of parts that already shipped: the serialized AOT
+executable cache gives every spawned replica a warm start from one shared
+cache directory, ``serving.prewarm`` boots it ready-to-serve, the capacity
+model publishes honest per-domain ``max_sustainable_qps`` + headroom +
+freshness on /healthz, and the SLO histograms were designed
+mergeable-cumulative — this package wires them into a fleet:
+
+- :class:`ReplicaManager` (``fleet.replica``) — spawn/adopt N
+  ``tools/serve.py`` processes over one shared config + cache dir, poll
+  their /healthz into a fleet view, refuse mismatched build fingerprints,
+  add (admit only after first healthy poll) and drain (stop routing, wait
+  for in-flight, terminate), plus autoscaling-shaped policy hooks with
+  counted, cause-attributed events.
+- :class:`Router` (``fleet.router``) — stdlib HTTP front forwarding
+  /attack to the replica with the most predicted headroom (polled
+  capacity QPS minus live in-flight), bounded-budget failover on
+  rejected/failed forwards, round-robin degradation without capacity, and
+  fleet-aggregated /healthz + /metrics with merged SLO histograms.
+- :func:`fleet_sweep` (``fleet.sweep``) — the ``bench.py --fleet``
+  harness: aggregate knee QPS at 1/2/4 replicas, shared-cache warm-start
+  evidence per replica, and the kill-a-replica chaos segment whose shed
+  accounting proves only dead-replica in-flight requests are lost.
+
+``tools/fleet.py`` is the operator CLI over the same pieces.
+"""
+
+from .replica import BuildMismatch, ReplicaHandle, ReplicaManager
+from .router import Router, RouterHTTPServer, serve_router
+
+__all__ = [
+    "BuildMismatch",
+    "ReplicaHandle",
+    "ReplicaManager",
+    "Router",
+    "RouterHTTPServer",
+    "serve_router",
+]

@@ -1,0 +1,629 @@
+"""Replica lifecycle for the fleet: spawn/adopt, poll, admit, drain, kill.
+
+A fleet replica is one ``tools/serve.py`` process — the whole single-node
+serving stack (engine caches, microbatcher, capacity model, SLO tracker)
+behind its stdlib HTTP front. The :class:`ReplicaManager` owns N of them:
+
+- **add** spawns a process with ``--port 0 --replica-id <rid>`` pointed at
+  one shared config (and thereby one shared AOT + artifact cache directory
+  — the PR-10 cross-process executable cache is what makes replica #N boot
+  as warm as replica #1), tails its stdout for the ``fleet_ready`` JSON
+  line to learn the bound port, then polls /healthz and **admits** the
+  replica into the routable set only after the first healthy poll whose
+  ``build`` fingerprint matches the fleet's.
+- **adopt** pools an already-running replica by URL under the same
+  fingerprint discipline — a replica built from a different config hash or
+  package version is *refused*, never routed to: capacity numbers and
+  bucket menus from mismatched builds are not comparable, and a router
+  balancing across them would mix incompatible attack semantics.
+- **drain** removes a replica from routing first, then waits for its
+  router-observed in-flight count and its own queue depth to reach zero
+  before terminating the process — in-flight requests complete, new ones
+  never arrive (the state machine DESIGN.md § fleet documents).
+- **kill** is SIGKILL with no grace — the chaos path. The manager marks
+  the replica dead; everything it had in flight is the router's failover
+  problem, and the fleet sweep's shed-accounting proof.
+
+Polling is pull-based (/healthz into a fleet view with per-replica
+freshness timestamps) so the router can discount a wedged replica's stale
+capacity instead of routing into it. The autoscaling-shaped policy hooks
+(:meth:`ReplicaManager.policy_tick`) watch the same view: sustained
+headroom exhaustion proposes a spawn, sustained idle proposes a drain,
+both surfaced as counted events with cause attribution (``observe`` mode
+counts only; ``act`` mode also performs the add/drain).
+
+Everything time- and process-shaped is injectable (``clock``, ``sleep``,
+``http_get``, ``spawn_fn``) so the state machine is testable with a fake
+clock and scripted health responses — no subprocesses, no sockets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Callable
+
+__all__ = [
+    "BuildMismatch",
+    "ReplicaHandle",
+    "ReplicaManager",
+    "default_http_get",
+]
+
+#: replica lifecycle states (the admit/drain state machine)
+STATES = (
+    "starting",  # spawned, not yet healthy-polled
+    "admitted",  # routable: healthy poll + matching build fingerprint
+    "draining",  # removed from routing; waiting for in-flight to finish
+    "terminated",  # drained and stopped (graceful end state)
+    "dead",  # process gone without drain (chaos / crash)
+    "refused",  # healthy but mismatched build fingerprint — never routed
+)
+
+
+class BuildMismatch(RuntimeError):
+    """A replica's /healthz ``build`` fingerprint does not match the
+    fleet's — pooling it would route one logical service across
+    incompatible configs/versions."""
+
+
+def default_http_get(url: str, timeout_s: float = 5.0) -> dict:
+    """GET ``url`` and parse the JSON body (the injectable default)."""
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+class ReplicaHandle:
+    """One replica as the manager sees it: process + URL + poll state.
+
+    ``in_flight`` is the *router-observed* concurrent-request count (the
+    router increments around each forward via
+    :meth:`ReplicaManager.note_inflight`) — the live half of the routing
+    signal, next to the capacity model's polled ``max_sustainable_qps``.
+    """
+
+    def __init__(self, replica_id: str, *, proc=None, url: str | None = None,
+                 log_path: str | None = None, spawned_t: float | None = None,
+                 log_start: int = 0):
+        self.replica_id = replica_id
+        self.proc = proc  #: Popen-like (None for adopted replicas)
+        self.url = url
+        self.log_path = log_path
+        self.log_start = log_start
+        self.state = "starting"
+        self.in_flight = 0
+        self.spawned_t = spawned_t
+        self.admitted_t: float | None = None
+        self.last_poll_t: float | None = None
+        self.last_health: dict | None = None
+        self.fingerprint: tuple | None = None
+        self.poll_errors = 0
+
+    # -- derived views -------------------------------------------------------
+    def capacity_qps(self) -> float | None:
+        """Fleet-summed ``max_sustainable_qps`` from the last healthy poll
+        (None when no capacity window is live yet)."""
+        health = self.last_health or {}
+        by_domain = (health.get("capacity") or {}).get("by_domain") or {}
+        vals = [
+            b.get("max_sustainable_qps")
+            for b in by_domain.values()
+            if b and b.get("max_sustainable_qps")
+        ]
+        return float(sum(vals)) if vals else None
+
+    def capacity_age_s(self) -> float | None:
+        """Staleness of the capacity window itself (max ``age_s`` across
+        domains) — distinct from poll staleness: a healthy replica serving
+        no traffic keeps publishing an aging window."""
+        health = self.last_health or {}
+        by_domain = (health.get("capacity") or {}).get("by_domain") or {}
+        ages = [
+            b.get("age_s")
+            for b in by_domain.values()
+            if b and b.get("age_s") is not None
+        ]
+        return float(max(ages)) if ages else None
+
+    def headroom(self) -> float | None:
+        """Min per-domain capacity headroom from the last poll."""
+        health = self.last_health or {}
+        by_domain = (health.get("capacity") or {}).get("by_domain") or {}
+        vals = [
+            b.get("headroom")
+            for b in by_domain.values()
+            if b and b.get("headroom") is not None
+        ]
+        return float(min(vals)) if vals else None
+
+    def view(self, now: float | None = None) -> dict:
+        """This replica's row in the fleet view."""
+        prewarm = (self.last_health or {}).get("prewarm")
+        return {
+            "replica_id": self.replica_id,
+            "state": self.state,
+            "url": self.url,
+            "pid": getattr(self.proc, "pid", None),
+            "in_flight": self.in_flight,
+            "poll_age_s": (
+                round(now - self.last_poll_t, 3)
+                if now is not None and self.last_poll_t is not None
+                else None
+            ),
+            "poll_errors": self.poll_errors,
+            "capacity_qps": self.capacity_qps(),
+            "capacity_age_s": self.capacity_age_s(),
+            "headroom": self.headroom(),
+            "queue_depth_rows": (self.last_health or {}).get(
+                "queue_depth_rows"
+            ),
+            "build": {
+                "version": self.fingerprint[0] if self.fingerprint else None,
+                "config_hash": self.fingerprint[1] if self.fingerprint else None,
+            },
+            "prewarm": prewarm,
+        }
+
+
+def _fingerprint(health: dict) -> tuple:
+    """The poolability fingerprint from a /healthz payload: package
+    version + config hash. Deliberately NOT ``git`` (two processes from
+    one checkout share it trivially) and NOT ``replica_id`` (ids differ by
+    construction)."""
+    build = health.get("build") or {}
+    return (build.get("version"), build.get("config_hash"))
+
+
+class ReplicaManager:
+    """Own N serve.py replicas over one shared config + cache directory.
+
+    All process/network/time effects are injectable:
+
+    - ``spawn_fn(replica_id) -> ReplicaHandle`` replaces the subprocess
+      spawn (tests return scripted handles);
+    - ``http_get(url) -> dict`` replaces urllib (tests script /healthz);
+    - ``clock`` / ``sleep`` replace time (fake-clock admit/drain tests).
+    """
+
+    def __init__(
+        self,
+        config_path: str | None = None,
+        *,
+        spawn_fn: Callable[[str], ReplicaHandle] | None = None,
+        http_get: Callable[[str], dict] = default_http_get,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        log_dir: str | None = None,
+        python: str = sys.executable,
+        prewarm: bool = True,
+        env: dict | None = None,
+        boot_timeout_s: float = 600.0,
+        poll_timeout_s: float = 60.0,
+        expected_build: tuple | None = None,
+        autoscale: dict | None = None,
+    ):
+        self.config_path = config_path
+        self.spawn_fn = spawn_fn
+        self.http_get = http_get
+        self.clock = clock
+        self.sleep = sleep
+        self.log_dir = log_dir
+        self.python = python
+        self.prewarm = prewarm
+        self.env = env
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        #: the fleet's build fingerprint: fixed up front, or learned from
+        #: the first admitted replica — every later admit must match
+        self.expected_build = expected_build
+        self._replicas: dict[str, ReplicaHandle] = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+        # -- autoscaling-shaped policy (observe-only by default) --------------
+        pol = dict(autoscale or {})
+        self.autoscale = {
+            "enabled": bool(pol.get("enabled", False)),
+            # observe: count events only; act: also perform the add/drain
+            "mode": pol.get("mode", "observe"),
+            "headroom_exhausted_below": float(
+                pol.get("headroom_exhausted_below", 0.10)
+            ),
+            "idle_utilization_below": float(
+                pol.get("idle_utilization_below", 0.05)
+            ),
+            "sustain_s": float(pol.get("sustain_s", 10.0)),
+            "min_replicas": int(pol.get("min_replicas", 1)),
+            "max_replicas": int(pol.get("max_replicas", 8)),
+        }
+        #: counted policy events with cause attribution
+        self.events: list[dict] = []
+        self.event_counts: dict[str, int] = {}
+        self._exhausted_since: float | None = None
+        self._idle_since: float | None = None
+
+    # -- identity ------------------------------------------------------------
+    def _new_id(self) -> str:
+        with self._lock:
+            self._next_id += 1
+            return f"r{self._next_id:02d}"
+
+    def replicas(self) -> list[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, replica_id: str) -> ReplicaHandle:
+        with self._lock:
+            return self._replicas[replica_id]
+
+    def routable(self) -> list[ReplicaHandle]:
+        """Replicas the router may forward to — admitted only. Draining,
+        dead, refused and still-starting replicas take no new traffic."""
+        with self._lock:
+            return [
+                h for h in self._replicas.values() if h.state == "admitted"
+            ]
+
+    # -- spawn ---------------------------------------------------------------
+    def _default_spawn(self, replica_id: str) -> ReplicaHandle:
+        """Spawn ``tools/serve.py -c <config> --port 0 --replica-id <rid>``
+        with stdout tailed to a per-replica log file (the ``fleet_ready``
+        line is read back from it)."""
+        if not self.config_path:
+            raise ValueError("ReplicaManager needs config_path to spawn")
+        serve_py = os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+            ),
+            "tools",
+            "serve.py",
+        )
+        log_dir = self.log_dir or os.path.join(
+            os.path.dirname(os.path.abspath(self.config_path)), "fleet_logs"
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"{replica_id}.log")
+        cmd = [
+            self.python,
+            serve_py,
+            "-c",
+            self.config_path,
+            "--port",
+            "0",
+            "--replica-id",
+            replica_id,
+        ]
+        if self.prewarm:
+            cmd.append("--prewarm")
+        # logs append across runs, so remember where THIS process's output
+        # starts — a stale fleet_ready line from a previous run must never
+        # win the port discovery below
+        log_start = os.path.getsize(log_path) if os.path.exists(log_path) else 0
+        logf = open(log_path, "ab")  # noqa: SIM115 — lifetime is the proc's
+        proc = subprocess.Popen(  # noqa: S603 — our own tools/serve.py
+            cmd,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            env=self.env,
+        )
+        return ReplicaHandle(
+            replica_id,
+            proc=proc,
+            log_path=log_path,
+            spawned_t=self.clock(),
+            log_start=log_start,
+        )
+
+    def _wait_ready(self, handle: ReplicaHandle) -> None:
+        """Tail the replica's log for its ``fleet_ready`` JSON line (the
+        bound URL under ``--port 0``)."""
+        if handle.url:
+            return
+        deadline = self.clock() + self.boot_timeout_s
+        while self.clock() < deadline:
+            if handle.proc is not None and handle.proc.poll() is not None:
+                handle.state = "dead"
+                raise RuntimeError(
+                    f"replica {handle.replica_id} exited rc="
+                    f"{handle.proc.returncode} before ready "
+                    f"(log: {handle.log_path})"
+                )
+            if handle.log_path and os.path.exists(handle.log_path):
+                with open(handle.log_path, "rb") as f:
+                    f.seek(getattr(handle, "log_start", 0))
+                    for raw in f:
+                        line = raw.strip()
+                        if not line.startswith(b'{"fleet_ready"'):
+                            continue
+                        try:
+                            ready = json.loads(line)["fleet_ready"]
+                        except (ValueError, KeyError):
+                            continue
+                        handle.url = ready["url"]
+                        return
+            self.sleep(0.2)
+        raise TimeoutError(
+            f"replica {handle.replica_id} not ready within "
+            f"{self.boot_timeout_s}s (log: {handle.log_path})"
+        )
+
+    # -- admit / adopt -------------------------------------------------------
+    def _admit(self, handle: ReplicaHandle) -> ReplicaHandle:
+        """Poll /healthz until the first healthy response; verify the
+        build fingerprint; admit or refuse. The routable set only ever
+        grows through here."""
+        deadline = self.clock() + self.poll_timeout_s
+        last_err: Exception | None = None
+        while self.clock() < deadline:
+            try:
+                health = self.http_get(handle.url + "/healthz")
+            except Exception as e:  # noqa: BLE001 — booting replica
+                last_err = e
+                handle.poll_errors += 1
+                if handle.proc is not None and handle.proc.poll() is not None:
+                    handle.state = "dead"
+                    raise RuntimeError(
+                        f"replica {handle.replica_id} exited rc="
+                        f"{handle.proc.returncode} during admission"
+                    ) from e
+                self.sleep(0.2)
+                continue
+            if not health.get("ok"):
+                self.sleep(0.2)
+                continue
+            handle.last_poll_t = self.clock()
+            handle.last_health = health
+            handle.fingerprint = _fingerprint(health)
+            if self.expected_build is None:
+                # first admitted replica defines the fleet's build
+                self.expected_build = handle.fingerprint
+            elif handle.fingerprint != tuple(self.expected_build):
+                handle.state = "refused"
+                self._terminate(handle)
+                raise BuildMismatch(
+                    f"replica {handle.replica_id} build "
+                    f"{handle.fingerprint} != fleet "
+                    f"{tuple(self.expected_build)} — refused"
+                )
+            handle.state = "admitted"
+            handle.admitted_t = self.clock()
+            return handle
+        raise TimeoutError(
+            f"replica {handle.replica_id} never became healthy within "
+            f"{self.poll_timeout_s}s (last error: {last_err!r})"
+        )
+
+    def add(self, replica_id: str | None = None) -> ReplicaHandle:
+        """Spawn + wait ready + admit-after-first-healthy-poll."""
+        rid = replica_id or self._new_id()
+        spawn = self.spawn_fn or self._default_spawn
+        handle = spawn(rid)
+        with self._lock:
+            self._replicas[rid] = handle
+        try:
+            self._wait_ready(handle)
+            self._admit(handle)
+        except BuildMismatch:
+            raise
+        except Exception:
+            if handle.state == "starting":
+                handle.state = "dead"
+            self._terminate(handle)
+            raise
+        return handle
+
+    def adopt(self, url: str, replica_id: str | None = None) -> ReplicaHandle:
+        """Pool an already-running replica by URL (no process handle —
+        drain stops routing and waits, but cannot terminate it)."""
+        rid = replica_id or self._new_id()
+        handle = ReplicaHandle(rid, url=url, spawned_t=self.clock())
+        with self._lock:
+            self._replicas[rid] = handle
+        self._admit(handle)
+        return handle
+
+    # -- polling / fleet view ------------------------------------------------
+    def poll(self) -> dict:
+        """One poll round over every live replica; returns the fleet view."""
+        now = self.clock()
+        for handle in self.replicas():
+            if handle.state not in ("admitted", "draining"):
+                continue
+            try:
+                health = self.http_get(handle.url + "/healthz")
+            except Exception:  # noqa: BLE001 — poll failure is a state
+                handle.poll_errors += 1
+                if handle.proc is not None and handle.proc.poll() is not None:
+                    handle.state = "dead"
+                continue
+            if health.get("ok"):
+                handle.last_poll_t = now
+                handle.last_health = health
+        return self.fleet_view()
+
+    def fleet_view(self) -> dict:
+        now = self.clock()
+        replicas = [h.view(now) for h in self.replicas()]
+        by_state: dict[str, int] = {}
+        for r in replicas:
+            by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+        qps = [
+            r["capacity_qps"]
+            for r in replicas
+            if r["state"] == "admitted" and r["capacity_qps"]
+        ]
+        return {
+            "replicas": replicas,
+            "by_state": by_state,
+            "routable": by_state.get("admitted", 0),
+            "fleet_capacity_qps": round(sum(qps), 2) if qps else None,
+            "expected_build": (
+                list(self.expected_build) if self.expected_build else None
+            ),
+            "policy": {
+                "autoscale": self.autoscale,
+                "event_counts": dict(self.event_counts),
+                "events": self.events[-16:],
+            },
+        }
+
+    def note_inflight(self, replica_id: str, delta: int) -> None:
+        """Router bookkeeping: +1 before a forward, -1 after it resolves."""
+        with self._lock:
+            handle = self._replicas.get(replica_id)
+            if handle is not None:
+                handle.in_flight = max(handle.in_flight + delta, 0)
+
+    # -- drain / kill --------------------------------------------------------
+    def _terminate(self, handle: ReplicaHandle) -> None:
+        proc = handle.proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except Exception:  # noqa: BLE001 — escalate to SIGKILL
+            proc.kill()
+            proc.wait(timeout=15)
+
+    def drain(self, replica_id: str, timeout_s: float = 60.0) -> dict:
+        """Graceful removal: stop routing first (state ``draining``), wait
+        for router-observed in-flight AND the replica's own queue depth to
+        reach zero, then terminate. Returns a drain report."""
+        handle = self.get(replica_id)
+        if handle.state not in ("admitted", "draining"):
+            raise ValueError(
+                f"cannot drain replica {replica_id} in state {handle.state}"
+            )
+        handle.state = "draining"
+        t0 = self.clock()
+        deadline = t0 + timeout_s
+        drained = False
+        while self.clock() < deadline:
+            depth = None
+            if handle.in_flight == 0:
+                try:
+                    health = self.http_get(handle.url + "/healthz")
+                    depth = health.get("queue_depth_rows")
+                except Exception:  # noqa: BLE001 — gone early = drained
+                    depth = 0
+                if not depth:
+                    drained = True
+                    break
+            self.sleep(0.1)
+        self._terminate(handle)
+        handle.state = "terminated"
+        return {
+            "replica_id": replica_id,
+            "drained_clean": drained,
+            "drain_s": round(self.clock() - t0, 3),
+        }
+
+    def kill(self, replica_id: str) -> dict:
+        """SIGKILL, no grace — the chaos path. In-flight requests on this
+        replica die with it; the fleet sweep's shed accounting proves the
+        router loses nothing else."""
+        handle = self.get(replica_id)
+        in_flight = handle.in_flight
+        if handle.proc is not None and handle.proc.poll() is None:
+            handle.proc.kill()
+            handle.proc.wait(timeout=15)
+        handle.state = "dead"
+        return {
+            "replica_id": replica_id,
+            "in_flight_at_kill": in_flight,
+            "pid": getattr(handle.proc, "pid", None),
+        }
+
+    def close(self) -> None:
+        for handle in self.replicas():
+            if handle.state in ("admitted", "draining", "starting"):
+                self._terminate(handle)
+                if handle.state != "starting":
+                    handle.state = "terminated"
+
+    # -- autoscaling-shaped policy --------------------------------------------
+    def _event(self, kind: str, cause: str, now: float, **extra) -> dict:
+        ev = {"t": round(now, 3), "kind": kind, "cause": cause, **extra}
+        self.events.append(ev)
+        key = f"{kind}:{cause}"
+        self.event_counts[key] = self.event_counts.get(key, 0) + 1
+        return ev
+
+    def policy_tick(self, now: float | None = None) -> list[dict]:
+        """One policy evaluation over the current fleet view: sustained
+        headroom exhaustion proposes a spawn, sustained idle proposes a
+        drain. ``observe`` mode counts the events; ``act`` mode also
+        performs them. Returns the events this tick emitted."""
+        if not self.autoscale["enabled"]:
+            return []
+        now = self.clock() if now is None else now
+        routable = self.routable()
+        emitted: list[dict] = []
+        headrooms = [
+            h.headroom() for h in routable if h.headroom() is not None
+        ]
+        # -- scale up: every routable replica's headroom exhausted ----------
+        exhausted = bool(headrooms) and all(
+            hr < self.autoscale["headroom_exhausted_below"] for hr in headrooms
+        )
+        if exhausted:
+            if self._exhausted_since is None:
+                self._exhausted_since = now
+            sustained = now - self._exhausted_since
+            if (
+                sustained >= self.autoscale["sustain_s"]
+                and len(routable) < self.autoscale["max_replicas"]
+            ):
+                ev = self._event(
+                    "scale_up",
+                    "headroom_exhausted",
+                    now,
+                    sustained_s=round(sustained, 3),
+                    replicas=len(routable),
+                    acted=self.autoscale["mode"] == "act",
+                )
+                emitted.append(ev)
+                self._exhausted_since = None  # one event per sustain window
+                if self.autoscale["mode"] == "act":
+                    self.add()
+        else:
+            self._exhausted_since = None
+        # -- scale down: sustained idle across the fleet --------------------
+        utils_ = [
+            1.0 - h.headroom()
+            for h in routable
+            if h.headroom() is not None
+        ]
+        idle = bool(utils_) and all(
+            u < self.autoscale["idle_utilization_below"] for u in utils_
+        )
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+            sustained = now - self._idle_since
+            if (
+                sustained >= self.autoscale["sustain_s"]
+                and len(routable) > self.autoscale["min_replicas"]
+            ):
+                victim = min(routable, key=lambda h: h.in_flight)
+                ev = self._event(
+                    "scale_down",
+                    "sustained_idle",
+                    now,
+                    sustained_s=round(sustained, 3),
+                    replicas=len(routable),
+                    victim=victim.replica_id,
+                    acted=self.autoscale["mode"] == "act",
+                )
+                emitted.append(ev)
+                self._idle_since = None
+                if self.autoscale["mode"] == "act":
+                    self.drain(victim.replica_id)
+        else:
+            self._idle_since = None
+        return emitted

@@ -1,0 +1,383 @@
+"""Capacity-driven HTTP router over a :class:`~.replica.ReplicaManager`.
+
+The routing signal is **predicted headroom**: for each admitted replica,
+the capacity model's fleet-summed ``max_sustainable_qps`` from its last
+healthy poll, minus the router-observed in-flight request count — the
+polled half says what the replica *can* absorb, the live half says what it
+is *already* absorbing. The score is a ranking signal, not a unit-honest
+rate (QPS minus a count), which is exactly what a router needs: replicas
+with equal polled capacity order by live load, replicas with equal load
+order by capacity.
+
+Freshness discipline: a replica's capacity block is trusted only when (a)
+its last healthy poll is within ``stale_after_s`` AND (b) the block's own
+``age_s`` (seconds since the capacity window's last batch — the satellite
+field ``observability/capacity.py`` publishes) is within
+``capacity_age_max_s``. Stale or absent capacity degrades that replica to
+the round-robin tail of the candidate order rather than excluding it —
+a fleet that has served no traffic yet (no capacity windows anywhere)
+routes pure round-robin.
+
+Failover: rejected (429) and failed (5xx, connection-refused/reset)
+forwards retry on the next-best replica with a bounded budget
+(``retry_budget`` retries after the first attempt). 400/413 are the
+client's problem and 504 means the request's deadline budget is already
+spent — none of those retry (a 504 retry would double-spend the deadline
+against a second replica). Every failover is a counted event with cause
+attribution; exhausting the budget returns the last upstream error (the
+final 429's honest ``Retry-After`` flows through) and counts a shed.
+
+The router serves fleet-aggregated ``/healthz`` (fleet view + per-replica
+health blocks + router counters) and ``/metrics`` (per-replica metric
+snapshots + **merged SLO histograms** via
+:func:`~...observability.slo.merge_slo_snapshots` — the fixed cumulative
+bucket layout was designed mergeable-cumulative in PR 7 for exactly this
+sum). ``/metrics?format=prom`` renders the merged SLO family and router
+counters as Prometheus text.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+from urllib.parse import parse_qs, urlsplit
+
+from ...observability.prom import _family, _fmt, _name, _slo_lines
+from ...observability.slo import merge_slo_snapshots
+from .replica import ReplicaHandle, ReplicaManager
+
+__all__ = ["Router", "RouterHTTPServer", "serve_router", "default_http_post"]
+
+#: upstream statuses that are safe + useful to retry on another replica
+RETRYABLE_STATUSES = frozenset({429, 500, 502, 503})
+
+
+def default_http_post(
+    url: str, body: bytes, timeout_s: float = 120.0
+) -> tuple[int, dict, bytes]:
+    """POST ``body`` as JSON; returns ``(status, headers, body)`` without
+    raising on HTTP error statuses (the router maps them itself).
+    Connection-level failures still raise (``URLError``/``OSError``) —
+    that distinction is the router's "failed" vs "rejected" cause split."""
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+class Router:
+    """Forward /attack to the replica with the most predicted headroom."""
+
+    def __init__(
+        self,
+        manager: ReplicaManager,
+        *,
+        retry_budget: int = 2,
+        stale_after_s: float = 10.0,
+        capacity_age_max_s: float = 30.0,
+        request_timeout_s: float = 120.0,
+        http_post: Callable[..., tuple[int, dict, bytes]] = default_http_post,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.manager = manager
+        self.retry_budget = int(retry_budget)
+        self.stale_after_s = float(stale_after_s)
+        self.capacity_age_max_s = float(capacity_age_max_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.http_post = http_post
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._rr = 0  #: round-robin cursor for the capacity-less tail
+        self.counters: dict[str, int] = {
+            "forwards": 0,
+            "retries": 0,
+            "shed_no_replica": 0,
+            "shed_budget_exhausted": 0,
+        }
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    # -- candidate ordering ---------------------------------------------------
+    def _fresh_capacity(self, handle: ReplicaHandle, now: float) -> float | None:
+        """The replica's trusted capacity QPS, or None when the poll or the
+        capacity window itself is stale/absent."""
+        if (
+            handle.last_poll_t is None
+            or now - handle.last_poll_t > self.stale_after_s
+        ):
+            return None
+        qps = handle.capacity_qps()
+        if qps is None:
+            return None
+        age = handle.capacity_age_s()
+        if age is not None and age > self.capacity_age_max_s:
+            return None
+        return qps
+
+    def candidates(self, now: float | None = None) -> list[ReplicaHandle]:
+        """Routable replicas in forward order: fresh-capacity replicas
+        ranked by predicted headroom (capacity QPS − in-flight), then the
+        capacity-less remainder in round-robin order."""
+        now = self.clock() if now is None else now
+        routable = self.manager.routable()
+        scored: list[tuple[float, ReplicaHandle]] = []
+        tail: list[ReplicaHandle] = []
+        for h in routable:
+            qps = self._fresh_capacity(h, now)
+            if qps is None:
+                tail.append(h)
+            else:
+                scored.append((qps - h.in_flight, h))
+        scored.sort(key=lambda sh: sh[0], reverse=True)
+        if tail:
+            with self._lock:
+                self._rr += 1
+                rot = self._rr % len(tail)
+            tail = tail[rot:] + tail[:rot]
+        return [h for _, h in scored] + tail
+
+    # -- forwarding -----------------------------------------------------------
+    def route(self, body: bytes) -> tuple[int, dict, bytes]:
+        """Forward one /attack body; returns ``(status, headers, body)``.
+        Headers include ``X-Served-By`` (the replica that produced the
+        returned response) and ``X-Fleet-Attempts``."""
+        order = self.candidates()
+        if not order:
+            self._count("shed_no_replica")
+            return (
+                503,
+                {"X-Fleet-Attempts": "0"},
+                json.dumps({"error": "no routable replica"}).encode(),
+            )
+        attempts = 0
+        last: tuple[int, dict, bytes] | None = None
+        last_rid = None
+        for handle in order[: self.retry_budget + 1]:
+            attempts += 1
+            if attempts > 1:
+                self._count("retries")
+            self.manager.note_inflight(handle.replica_id, +1)
+            try:
+                status, headers, resp_body = self.http_post(
+                    handle.url + "/attack",
+                    body,
+                    timeout_s=self.request_timeout_s,
+                )
+            except Exception:  # noqa: BLE001 — connection-level failure
+                # dead/unreachable replica: the chaos path. Count the
+                # cause and try the next-best candidate
+                self._count(f"failover_connection:{handle.replica_id}")
+                self._count("failover_connection_total")
+                last = (
+                    502,
+                    {},
+                    json.dumps(
+                        {
+                            "error": "replica connection failed",
+                            "replica_id": handle.replica_id,
+                        }
+                    ).encode(),
+                )
+                last_rid = handle.replica_id
+                continue
+            finally:
+                self.manager.note_inflight(handle.replica_id, -1)
+            last = (status, headers, resp_body)
+            last_rid = handle.replica_id
+            if status in RETRYABLE_STATUSES:
+                cause = "rejected" if status == 429 else "failed"
+                self._count(f"failover_{cause}:{handle.replica_id}")
+                self._count(f"failover_{cause}_total")
+                continue
+            # success, or a non-retryable client/deadline error: done
+            self._count("forwards")
+            return self._stamp(last, last_rid, attempts)
+        # budget exhausted: surface the last upstream answer honestly (a
+        # final 429's Retry-After flows through to the client)
+        self._count("shed_budget_exhausted")
+        return self._stamp(last, last_rid, attempts)
+
+    @staticmethod
+    def _stamp(
+        result: tuple[int, dict, bytes], replica_id, attempts: int
+    ) -> tuple[int, dict, bytes]:
+        status, headers, body = result
+        out = {
+            k: v
+            for k, v in headers.items()
+            if k.lower() in ("retry-after", "x-replica-id")
+        }
+        if replica_id:
+            out["X-Served-By"] = str(replica_id)
+        out["X-Fleet-Attempts"] = str(attempts)
+        return status, out, body
+
+    # -- aggregated views -----------------------------------------------------
+    def healthz(self) -> dict:
+        """Fleet-aggregated health: the manager's fleet view, per-replica
+        health blocks (last poll), and router counters."""
+        view = self.manager.fleet_view()
+        return {
+            "ok": view["routable"] > 0,
+            "fleet": view,
+            "router": {
+                "retry_budget": self.retry_budget,
+                "stale_after_s": self.stale_after_s,
+                "capacity_age_max_s": self.capacity_age_max_s,
+                "counters": self.counters_snapshot(),
+            },
+            "replicas": {
+                h.replica_id: h.last_health
+                for h in self.manager.replicas()
+                if h.last_health is not None
+            },
+        }
+
+    def counters_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.counters)
+
+    def metrics(self, http_get=None) -> dict:
+        """Fleet-aggregated metrics: per-replica /metrics snapshots plus
+        the merged SLO histogram view (cumulative buckets sum across
+        replicas; quantiles re-derived from the merged distribution)."""
+        http_get = http_get or self.manager.http_get
+        per_replica: dict[str, dict] = {}
+        for h in self.manager.routable():
+            try:
+                per_replica[h.replica_id] = http_get(h.url + "/metrics")
+            except Exception:  # noqa: BLE001 — a scrape miss is a gap,
+                per_replica[h.replica_id] = None  # not an outage
+        slo_snaps = [
+            m.get("slo")
+            for m in per_replica.values()
+            if isinstance(m, dict) and m.get("slo")
+        ]
+        return {
+            "router": {"counters": self.counters_snapshot()},
+            "fleet": self.manager.fleet_view(),
+            "slo_merged": merge_slo_snapshots(slo_snaps),
+            "per_replica": per_replica,
+        }
+
+    def prometheus_text(self, prefix: str = "moeva2_fleet") -> str:
+        """Prometheus exposition of the merged fleet view: the merged SLO
+        histogram family (same native-histogram layout as a single
+        replica's — Prometheus-side aggregation and this router-side merge
+        agree by construction) plus router counters and routable gauge."""
+        snap = self.metrics()
+        lines: list[str] = []
+        _family(lines, _name(prefix, "routable_replicas"), "gauge")
+        lines.append(
+            f"{_name(prefix, 'routable_replicas')} "
+            f"{_fmt(snap['fleet']['routable'])}"
+        )
+        counters = snap["router"]["counters"]
+        _family(lines, _name(prefix, "router_events_total"), "counter")
+        for key in sorted(counters):
+            if ":" in key:  # per-replica attributions stay JSON-side
+                continue
+            lines.append(
+                f"{_name(prefix, 'router_events_total')}"
+                f'{{event="{key}"}} {_fmt(counters[key])}'
+            )
+        merged = snap.get("slo_merged")
+        if merged:
+            _slo_lines(prefix, merged, lines)
+        return "\n".join(lines) + "\n"
+
+
+class RouterHTTPHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "RouterHTTPServer"
+
+    def _send(self, code: int, body: bytes, headers: dict, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: dict, headers: dict | None = None):
+        self._send(
+            code,
+            json.dumps(obj).encode(),
+            headers or {},
+            "application/json",
+        )
+
+    def log_message(self, fmt, *args):
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    def do_GET(self):
+        router = self.server.router
+        parts = urlsplit(self.path)
+        if parts.path == "/healthz":
+            self._send_json(200, router.healthz())
+        elif parts.path == "/metrics":
+            query = parse_qs(parts.query)
+            if query.get("format", [""])[0] == "prom":
+                self._send(
+                    200,
+                    router.prometheus_text().encode(),
+                    {},
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._send_json(200, router.metrics())
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._send_json(400, {"error": "bad Content-Length header"})
+            self.close_connection = True
+            return
+        body = self.rfile.read(length)
+        if self.path != "/attack":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        status, headers, resp_body = self.server.router.route(body)
+        self._send(status, resp_body, headers, "application/json")
+
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(
+        self,
+        addr: tuple[str, int],
+        router: Router,
+        *,
+        verbose: bool = False,
+    ):
+        super().__init__(addr, RouterHTTPHandler)
+        self.router = router
+        self.verbose = verbose
+
+
+def serve_router(
+    router: Router,
+    host: str = "127.0.0.1",
+    port: int = 8700,
+    **kw,
+) -> RouterHTTPServer:
+    """Bind and return the router front (caller runs ``serve_forever``;
+    port 0 picks an ephemeral port — read ``server.server_address``)."""
+    return RouterHTTPServer((host, port), router, **kw)

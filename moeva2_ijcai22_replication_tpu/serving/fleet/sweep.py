@@ -1,0 +1,422 @@
+"""Fleet sweep: aggregate knee at 1/2/4 replicas + kill-a-replica chaos.
+
+The ``bench.py --fleet`` harness and the FLEET record producer. Unlike
+``serving.sweep`` (in-process, no network) this drives *real replica
+subprocesses* over HTTP through the fleet :class:`~.router.Router` —
+the router's candidate ordering, failover, and shed accounting are the
+system under test, so the sweep calls :meth:`Router.route` directly with
+the same open-loop pacing discipline as every other level runner
+(:func:`arrival_offsets`, latency charged from the *scheduled* arrival —
+the coordinated-omission rule).
+
+Four phases, one record:
+
+1. **Warm seed** — a throwaway replica is spawned with ``--prewarm``,
+   pays every compile once into the shared AOT cache directory, and is
+   drained. Every *measured* replica (including the first) then boots
+   from a hot cache — the record's per-replica warm evidence
+   (``aot_hits / executables`` from the /healthz prewarm report) is the
+   PR-10 cross-process cache made load-bearing, and the acceptance gate
+   (≥ 90 % per replica) would catch a cache-layout regression.
+2. **Scaling ladder** — for each replica count (1/2/4 by default), the
+   offered-rate ladder is the per-replica ladder × N: a fleet that
+   scales linearly holds the same *per-replica* rate at every N. The
+   per-count knee (:func:`detect_knee`, the PR-7 rule: completion ratio
+   ≥ 0.9 at p99 ≤ 3× baseline) yields the scaling ratio
+   ``knee(4) / (4 × knee(1))`` the ``bench_diff --fleet`` gate holds
+   ≥ 0.8.
+3. **Chaos** — the fleet is drained down to two replicas (exercising the
+   graceful path), a level is offered at the 2-replica knee, and halfway
+   through the submission schedule the busier replica is SIGKILLed with
+   its router-observed in-flight count snapshotted at the kill instant.
+   Accounting: every request that fails terminally must attribute to the
+   dead replica (``lost_unaccounted`` must be 0), losses are bounded by
+   the in-flight-at-kill count, and connection failovers ≈ the dead
+   replica's interrupted in-flight set — the router lost nothing it
+   didn't have to.
+4. **Recovery** — the per-replica ladder re-runs on the survivor; the
+   post-kill knee must recover to the (N−1)-replica (here 1-replica)
+   knee within the gate's floor.
+
+Single-host honesty: on a small shared host the per-replica knee must be
+*admission-limited* (queue bound + batching delay), not device-limited —
+N replicas then genuinely multiply aggregate admission capacity, which
+is the property this sweep proves. ``bench.py --fleet`` configures the
+replicas accordingly (see ``run_fleet_bench``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+from ...observability import (
+    detect_knee,
+    get_gap_tracker,
+    get_ledger,
+    telemetry_block,
+    validate_record,
+)
+from ...utils.observability import arrival_offsets, percentile
+from .replica import ReplicaManager
+from .router import Router
+
+
+def run_fleet_level(
+    router: Router,
+    make_body: Callable[[int], bytes],
+    offered_rps: float,
+    n_requests: int,
+    *,
+    timeout_s: float = 120.0,
+    max_workers: int = 64,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    arrival: str = "poisson",
+    seed: int = 42,
+    mid_hook: Callable[[int], None] | None = None,
+    detail: bool = False,
+) -> dict:
+    """One offered-load level through the router: submit ``n_requests``
+    paced at ``offered_rps``, classify every final status, report the
+    level record (same keys as ``serving.sweep.run_level`` so
+    :func:`detect_knee` and the gates read both). ``mid_hook`` fires once
+    just before the midpoint submission — the chaos segment's kill
+    point."""
+    offsets = arrival_offsets(arrival, offered_rps, n_requests, seed)
+    results: list[dict] = []
+    pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    def one(i: int, t_sub: float, body: bytes) -> dict:
+        try:
+            status, headers, resp = router.route(body)
+        except Exception as e:  # noqa: BLE001 — bench counts, not raises
+            return {"i": i, "status": -1, "error": repr(e), "t_sub": t_sub}
+        out = {
+            "i": i,
+            "status": status,
+            "t_sub": t_sub,
+            "t_done": clock(),
+            "served_by": headers.get("X-Served-By"),
+            "attempts": int(headers.get("X-Fleet-Attempts", 1)),
+        }
+        if status == 200:
+            try:
+                meta = json.loads(resp).get("meta") or {}
+                out["rows"] = int(meta.get("rows") or 0)
+                out["occupancy"] = meta.get("batch_occupancy")
+            except ValueError:
+                pass
+        else:
+            try:
+                err = json.loads(resp)
+                out["error"] = err.get("error")
+                out["error_replica"] = err.get("replica_id")
+            except ValueError:
+                out["error"] = resp[:200].decode("utf-8", "replace")
+        return out
+
+    mid = n_requests // 2
+    t_start = clock()
+    futs = []
+    for i in range(n_requests):
+        target = t_start + offsets[i]
+        delta = target - clock()
+        if delta > 0:
+            sleep(delta)
+        if mid_hook is not None and i == mid:
+            mid_hook(i)
+        # latency origin is the SCHEDULED arrival (coordinated-omission
+        # rule shared with serving.sweep / tools/loadgen.py)
+        t_sub = target if offered_rps > 0 else clock()
+        futs.append(pool.submit(one, i, t_sub, make_body(i)))
+    for fut in futs:
+        results.append(fut.result(timeout=timeout_s))
+    pool.shutdown(wait=True)
+    duration = max(clock() - t_start, 1e-9)
+
+    ok = [r for r in results if r["status"] == 200]
+    latencies = sorted(r["t_done"] - r["t_sub"] for r in ok)
+    occup = [r["occupancy"] for r in ok if r.get("occupancy") is not None]
+    served_by: dict[str, int] = {}
+    for r in ok:
+        rid = r.get("served_by") or "(unknown)"
+        served_by[rid] = served_by.get(rid, 0) + 1
+    n_ok = len(ok)
+    level = {
+        "offered_rps": offered_rps,
+        "arrival": arrival,
+        "n_requests": n_requests,
+        "completed": n_ok,
+        "rejected": sum(1 for r in results if r["status"] == 429),
+        "deadline_timeouts": sum(1 for r in results if r["status"] == 504),
+        "failed": sum(
+            1 for r in results if r["status"] not in (200, 429, 504)
+        ),
+        "retried": sum(1 for r in results if r.get("attempts", 1) > 1),
+        "duration_s": round(duration, 3),
+        "throughput_rps": round(n_ok / duration, 2),
+        "throughput_rows_s": round(
+            sum(r.get("rows", 0) for r in ok) / duration, 1
+        ),
+        "completion_ratio": round(n_ok / n_requests, 4) if n_requests else None,
+        "p50_ms": round(percentile(latencies, 0.50) * 1e3, 2) if n_ok else None,
+        "p99_ms": round(percentile(latencies, 0.99) * 1e3, 2) if n_ok else None,
+        "quantiles_n": n_ok,
+        "mean_batch_occupancy": (
+            round(sum(occup) / len(occup), 4) if occup else None
+        ),
+        "served_by": served_by,
+    }
+    if detail:
+        level["requests"] = results
+    return level
+
+
+def _warm_evidence(manager: ReplicaManager, exclude=()) -> dict:
+    """Per-replica AOT warm-start evidence from the /healthz prewarm
+    reports: how much of each measured replica's boot came out of the
+    shared serialized-executable cache."""
+    per_replica: dict[str, dict] = {}
+    for h in manager.replicas():
+        if h.replica_id in exclude or h.last_health is None:
+            continue
+        pre = h.last_health.get("prewarm") or {}
+        executables = int(pre.get("executables") or 0)
+        aot_hits = int(pre.get("aot_hits") or 0)
+        per_replica[h.replica_id] = {
+            "executables": executables,
+            "aot_hits": aot_hits,
+            "prewarm_s": pre.get("seconds"),
+            "warm_fraction": (
+                round(aot_hits / executables, 4) if executables else None
+            ),
+        }
+    fracs = [
+        v["warm_fraction"]
+        for v in per_replica.values()
+        if v["warm_fraction"] is not None
+    ]
+    return {
+        "per_replica": per_replica,
+        "min_warm_fraction": min(fracs) if fracs else None,
+    }
+
+
+def fleet_sweep(
+    config_path: str,
+    make_body: Callable[[int], bytes],
+    *,
+    counts: Sequence[int] = (1, 2, 4),
+    per_replica_rates: Sequence[float] = (8.0, 13.0, 18.0, 25.0),
+    n_requests: int = 80,
+    chaos: bool = True,
+    timeout_s: float = 120.0,
+    arrival: str = "poisson",
+    seed: int = 42,
+    manager_kw: dict | None = None,
+    router_kw: dict | None = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict:
+    """Run the full fleet proof; returns the validated FLEET record."""
+    ledger_mark = get_ledger().mark()
+    gaps_mark = get_gap_tracker().mark()
+    manager = ReplicaManager(config_path, **(manager_kw or {}))
+    # the sweep measures AGGREGATE admission capacity, so its failover
+    # budget must be able to reach every replica: capacity scores are
+    # frozen between polls, arrivals concentrate on the top-scored
+    # replica, and with a smaller budget a rejected request can exhaust
+    # its retries while a further-down replica still has queue room —
+    # the measured knee would then reflect the budget, not the fleet
+    router_kw = dict(router_kw or {})
+    router_kw.setdefault("retry_budget", max(int(c) for c in counts) - 1)
+    router = Router(manager, **router_kw)
+    level_kw = dict(
+        timeout_s=timeout_s,
+        arrival=arrival,
+        clock=clock,
+        sleep=sleep,
+    )
+    try:
+        # -- phase 1: seed the shared AOT cache -------------------------------
+        seed_handle = manager.add("warmseed")
+        warmseed = {
+            "prewarm": (seed_handle.last_health or {}).get("prewarm"),
+            "drain": manager.drain("warmseed"),
+        }
+
+        # -- phase 2: scaling ladder ------------------------------------------
+        stages = []
+        knee_by_count: dict[int, float | None] = {}
+        for count in counts:
+            while len(manager.routable()) < count:
+                manager.add()
+            manager.poll()
+            levels = []
+            for li, rate in enumerate(per_replica_rates):
+                levels.append(
+                    run_fleet_level(
+                        router,
+                        make_body,
+                        float(rate) * count,
+                        n_requests * count,
+                        seed=seed + li,
+                        **level_kw,
+                    )
+                )
+                manager.poll()  # refresh capacity between levels
+            knee = detect_knee(levels)
+            knee_by_count[count] = knee["knee_rps"]
+            stages.append(
+                {
+                    "replicas": count,
+                    "levels": levels,
+                    "knee": knee,
+                    "fleet": manager.fleet_view(),
+                }
+            )
+        n_lo, n_hi = min(counts), max(counts)
+        knee_lo, knee_hi = knee_by_count.get(n_lo), knee_by_count.get(n_hi)
+        scaling = {
+            "knee_by_replicas": {str(k): v for k, v in knee_by_count.items()},
+            # the acceptance ratio: knee(N_hi) over linear extrapolation
+            # of knee(N_lo) — 1.0 is perfectly linear scale-out
+            "linear_ratio": (
+                round(knee_hi / (knee_lo * (n_hi / n_lo)), 4)
+                if knee_lo and knee_hi
+                else None
+            ),
+            "from_replicas": n_lo,
+            "to_replicas": n_hi,
+        }
+        warm = _warm_evidence(manager, exclude=("warmseed",))
+
+        # -- phase 3 + 4: chaos + recovery ------------------------------------
+        chaos_block = None
+        if chaos and len(manager.routable()) >= 2:
+            # drain down to two replicas — the graceful path, on record
+            drains = []
+            victims = sorted(
+                manager.routable(), key=lambda h: h.replica_id
+            )
+            for h in victims[2:]:
+                drains.append(manager.drain(h.replica_id))
+            manager.poll()
+            pair = sorted(manager.routable(), key=lambda h: h.replica_id)
+            chaos_rate = knee_by_count.get(2) or (
+                2 * float(per_replica_rates[len(per_replica_rates) // 2])
+            )
+            kill_report: dict = {}
+
+            def mid_hook(_i: int) -> None:
+                # kill the busier of the pair at the schedule midpoint,
+                # snapshotting its router-observed in-flight count first —
+                # the bound every loss must attribute under
+                victim = max(pair, key=lambda h: h.in_flight)
+                kill_report.update(manager.kill(victim.replica_id))
+
+            counters_before = router.counters_snapshot()
+            chaos_level = run_fleet_level(
+                router,
+                make_body,
+                chaos_rate,
+                n_requests * 2,
+                seed=seed + 101,
+                mid_hook=mid_hook,
+                detail=True,
+                **level_kw,
+            )
+            counters_after = router.counters_snapshot()
+            victim_id = kill_report.get("replica_id")
+            requests = chaos_level.pop("requests")
+            lost_dead = lost_unaccounted = 0
+            for r in requests:
+                if r["status"] in (200, 429, 504):
+                    continue
+                # terminal failure: must attribute to the dead replica
+                if r.get("error_replica") == victim_id or (
+                    r.get("served_by") == victim_id
+                ):
+                    lost_dead += 1
+                else:
+                    lost_unaccounted += 1
+            failovers = {
+                k: counters_after.get(k, 0) - counters_before.get(k, 0)
+                for k in counters_after
+                if k.startswith(("failover_", "retries", "shed_"))
+                and counters_after.get(k, 0) != counters_before.get(k, 0)
+            }
+            # recovery: the survivor re-runs the per-replica ladder; its
+            # knee must come back to the (N-1)=1-replica level
+            manager.poll()
+            recovery_levels = [
+                run_fleet_level(
+                    router,
+                    make_body,
+                    float(rate),
+                    n_requests,
+                    seed=seed + 201 + li,
+                    **level_kw,
+                )
+                for li, rate in enumerate(per_replica_rates)
+            ]
+            recovery_knee = detect_knee(recovery_levels)
+            knee_1 = knee_by_count.get(1)
+            chaos_block = {
+                "offered_rps": chaos_rate,
+                "kill": kill_report,
+                "drains_before": drains,
+                "level": chaos_level,
+                "shed_accounting": {
+                    "in_flight_at_kill": kill_report.get("in_flight_at_kill"),
+                    "lost_dead_replica": lost_dead,
+                    "lost_unaccounted": lost_unaccounted,
+                    "rejected_backpressure": chaos_level["rejected"],
+                    "retried": chaos_level["retried"],
+                    "router_failover_delta": failovers,
+                },
+                "recovery": {
+                    "levels": recovery_levels,
+                    "knee": recovery_knee,
+                    "knee_n_minus_1": knee_1,
+                    "recovery_ratio": (
+                        round(recovery_knee["knee_rps"] / knee_1, 4)
+                        if recovery_knee["knee_rps"] and knee_1
+                        else None
+                    ),
+                },
+            }
+    finally:
+        final_view = manager.fleet_view()
+        manager.close()
+
+    record = {
+        "counts": list(counts),
+        "per_replica_rates": [float(r) for r in per_replica_rates],
+        "n_requests_per_replica": n_requests,
+        "arrival": arrival,
+        "warmseed": warmseed,
+        "stages": stages,
+        "scaling": scaling,
+        "warm": warm,
+        "chaos": chaos_block,
+        "router": {"counters": router.counters_snapshot()},
+        "fleet_final": final_view,
+        # the attack work ran in the replica subprocesses; this driver's
+        # ledger/gaps windows are honestly near-empty (noted so a reader
+        # of telemetry.cost doesn't mistake the router for the fleet)
+        "work_in": "replica_subprocesses",
+        "execution": {
+            "mesh": None,
+            "replica_counts": list(counts),
+            "router_retry_budget": router.retry_budget,
+        },
+        "telemetry": telemetry_block(
+            ledger_since=ledger_mark,
+            gaps_since=gaps_mark,
+        ),
+    }
+    return validate_record(record, "fleet")

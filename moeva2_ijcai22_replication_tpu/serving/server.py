@@ -59,6 +59,11 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # replica attribution on every response (incl. errors): the fleet
+        # router and the chaos sweep account shed/served per replica by it
+        rid = getattr(self.server.service, "replica_id", None)
+        if rid:
+            self.send_header("X-Replica-Id", rid)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()

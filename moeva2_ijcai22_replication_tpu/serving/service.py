@@ -188,9 +188,16 @@ class AttackService:
         capacity_window: int = 256,
         clock: Callable[[], float] | None = None,
         start: bool = True,
+        replica_id: str | None = None,
     ):
         self.domains = dict(domains)
         self.seed = int(seed)
+        # fleet label: threaded into trace ids, /healthz, and /metrics so a
+        # ReplicaManager pooling N processes can attribute every request and
+        # metric line to the replica that served it. Not part of the build
+        # fingerprint — replicas with different ids but the same config are
+        # interchangeable by design
+        self.replica_id = str(replica_id) if replica_id else None
         # the unified tracing recorder: counters always mirror into it; when
         # its spans are enabled (``serving.trace_log`` / an explicit
         # TraceRecorder(spans_enabled=True)), every request gets a
@@ -230,6 +237,9 @@ class AttackService:
             slo=self.slo,
             clock=self.clock,
             start=start,
+            # honest 429 Retry-After: predicted drain time of the queued
+            # rows at the capacity window's sustainable row rate
+            retry_after_fn=self.capacity.retry_after_s,
         )
         self._resolved: dict[tuple, _Resolved] = {}
         #: boot-time warmup report (None until :meth:`prewarm` ran)
@@ -685,10 +695,13 @@ class AttackService:
         rid = req.request_id or uuid.uuid4().hex[:12]
         # request-scoped trace (None when spans are off — the whole request
         # path then does no trace work at all, the overhead contract)
+        # replica-labelled trace ids: a fleet's merged trace streams stay
+        # attributable per process
+        tid = f"{self.replica_id}:req-{rid}" if self.replica_id else f"req-{rid}"
         trace = (
             Trace(
                 self.recorder,
-                trace_id=f"req-{rid}",
+                trace_id=tid,
                 name=f"{req.attack}/{req.domain}",
             )
             if self.recorder.spans_enabled
@@ -879,6 +892,9 @@ class AttackService:
         return {
             "ok": True,
             "uptime_s": round(time.time() - self._t0, 3),
+            # fleet label (None outside a fleet): the ReplicaManager keys
+            # its fleet view by this, and refuses a replica whose id moved
+            "replica_id": self.replica_id,
             "domains": sorted(self.domains),
             "queue_depth_rows": self.batcher.queue_depth_rows(),
             "bucket_menu": list(self.menu.sizes),
@@ -948,6 +964,7 @@ class AttackService:
 
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
+        snap["replica_id"] = self.replica_id
         snap["engine_cache"] = common.ENGINES.stats()
         snap["artifact_cache"] = common.ARTIFACTS.stats()
         snap["resolved_run_configs"] = len(self._resolved)

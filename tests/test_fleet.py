@@ -1,0 +1,929 @@
+"""Fleet-layer tests: replica lifecycle, capacity routing, SLO merge.
+
+The fake tier drives the admit/drain/kill state machine and the router's
+headroom ranking + failover budget with an injected clock and scripted
+/healthz + /attack endpoints — no subprocesses, no sockets. The slow tier
+spawns two real ``tools/serve.py`` replicas over one shared config via
+:class:`ReplicaManager`, SIGKILLs one behind the router's back, and proves
+the forward fails over to the survivor within the retry budget before the
+survivor drains cleanly.
+"""
+
+import json
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.observability.capacity import CapacityModel
+from moeva2_ijcai22_replication_tpu.observability.slo import (
+    SloTracker,
+    merge_histogram_snapshots,
+    merge_slo_snapshots,
+)
+from moeva2_ijcai22_replication_tpu.serving import (
+    BucketMenu,
+    Microbatcher,
+    QueueFull,
+)
+from moeva2_ijcai22_replication_tpu.serving.fleet import (
+    BuildMismatch,
+    ReplicaHandle,
+    ReplicaManager,
+    Router,
+    serve_router,
+)
+from moeva2_ijcai22_replication_tpu.utils.observability import ServiceMetrics
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeProc:
+    """Popen-shaped test double; ``on_terminate`` observes the call site's
+    state at SIGTERM time (the drain-ordering proof)."""
+
+    def __init__(self, pid=4321, on_terminate=None):
+        self.pid = pid
+        self.returncode = None
+        self.terminated = False
+        self.killed = False
+        self.on_terminate = on_terminate
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        if self.on_terminate:
+            self.on_terminate()
+        self.terminated = True
+        self.returncode = 0
+
+    def kill(self):
+        self.killed = True
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+class ScriptedHTTP:
+    """url -> scripted responses; the last entry repeats, Exceptions raise."""
+
+    def __init__(self):
+        self.scripts = {}
+        self.calls = []
+
+    def set(self, url, *responses):
+        self.scripts[url] = list(responses)
+
+    def __call__(self, url):
+        self.calls.append(url)
+        seq = self.scripts[url]
+        resp = seq.pop(0) if len(seq) > 1 else seq[0]
+        if isinstance(resp, Exception):
+            raise resp
+        return resp() if callable(resp) else resp
+
+
+class ScriptedPost:
+    """url -> (status, headers, body) | Exception | callable, per /attack."""
+
+    def __init__(self, responses):
+        self.responses = dict(responses)
+        self.calls = []
+
+    def __call__(self, url, body, timeout_s=None):
+        self.calls.append(url)
+        resp = self.responses[url]
+        if callable(resp) and not isinstance(resp, Exception):
+            resp = resp()
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+
+def health(
+    rid,
+    *,
+    version="0.1",
+    config_hash="abc",
+    qps=None,
+    age=None,
+    headroom=None,
+    queue=0,
+    ok=True,
+):
+    h = {
+        "ok": ok,
+        "replica_id": rid,
+        "queue_depth_rows": queue,
+        "build": {"version": version, "config_hash": config_hash},
+    }
+    if qps is not None or headroom is not None:
+        block = {}
+        if qps is not None:
+            block["max_sustainable_qps"] = qps
+        if age is not None:
+            block["age_s"] = age
+        if headroom is not None:
+            block["headroom"] = headroom
+        h["capacity"] = {"by_domain": {"lcld": block}}
+    return h
+
+
+def make_fleet(healths, clock=None, **mgr_kw):
+    """Manager with one adopted (admitted) replica per ``healths`` entry."""
+    fc = clock or FakeClock()
+    http = ScriptedHTTP()
+    for rid, h in healths.items():
+        http.set(f"mem://{rid}/healthz", h)
+    mgr = ReplicaManager(
+        http_get=http, clock=fc, sleep=fc.advance, **mgr_kw
+    )
+    for rid in healths:
+        mgr.adopt(f"mem://{rid}", rid)
+    return mgr, http, fc
+
+
+# ---------------------------------------------------------------------------
+# replica lifecycle (fake clock, scripted endpoints)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaLifecycle:
+    def test_add_admits_after_first_healthy_poll(self):
+        fc = FakeClock()
+        http = ScriptedHTTP()
+        # boot sequence: connection refused, then unready, then healthy —
+        # the replica must only become routable after the healthy poll
+        http.set(
+            "mem://r01/healthz",
+            ConnectionError("booting"),
+            health("r01", ok=False),
+            health("r01"),
+        )
+        proc = FakeProc()
+        spawn = lambda rid: ReplicaHandle(
+            rid, proc=proc, url="mem://r01", spawned_t=fc()
+        )
+        mgr = ReplicaManager(
+            spawn_fn=spawn, http_get=http, clock=fc, sleep=fc.advance
+        )
+        h = mgr.add()
+        assert h.state == "admitted"
+        assert h.poll_errors == 1  # the connection-refused round
+        assert h.last_poll_t is not None and h.admitted_t is not None
+        assert mgr.routable() == [h]
+        # the first admitted replica defines the fleet's build fingerprint
+        assert mgr.expected_build == ("0.1", "abc")
+
+    def test_build_mismatch_refused_at_add(self):
+        fc = FakeClock()
+        http = ScriptedHTTP()
+        http.set("mem://r01/healthz", health("r01", config_hash="zzz"))
+        proc = FakeProc()
+        spawn = lambda rid: ReplicaHandle(
+            rid, proc=proc, url="mem://r01", spawned_t=fc()
+        )
+        mgr = ReplicaManager(
+            spawn_fn=spawn,
+            http_get=http,
+            clock=fc,
+            sleep=fc.advance,
+            expected_build=("0.1", "abc"),
+        )
+        with pytest.raises(BuildMismatch, match="refused"):
+            mgr.add()
+        h = mgr.replicas()[0]
+        assert h.state == "refused"
+        assert proc.terminated  # a refused spawn is not left running
+        assert mgr.routable() == []
+
+    def test_wait_ready_skips_stale_fleet_ready_lines(self, tmp_path):
+        # replica logs append across runs, so a restarted fleet sees the
+        # PREVIOUS process's fleet_ready line first — discovery must only
+        # read bytes written after this spawn's log_start offset
+        log = tmp_path / "r01.log"
+        stale = json.dumps(
+            {"fleet_ready": {"url": "mem://stale", "port": 1}}
+        )
+        fresh = json.dumps(
+            {"fleet_ready": {"url": "mem://fresh", "port": 2}}
+        )
+        log.write_text(stale + "\n" + fresh + "\n")
+        fc = FakeClock()
+        mgr = ReplicaManager(clock=fc, sleep=fc.advance)
+        h = ReplicaHandle(
+            "r01",
+            proc=FakeProc(),
+            log_path=str(log),
+            spawned_t=fc(),
+            log_start=len(stale) + 1,
+        )
+        mgr._wait_ready(h)
+        assert h.url == "mem://fresh"
+
+    def test_build_mismatch_refused_at_adoption(self):
+        # first adoption defines the fleet build; the second, healthy but
+        # differently-built, must be refused — never routed to
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        http.set("mem://r02/healthz", health("r02", version="0.2"))
+        with pytest.raises(BuildMismatch):
+            mgr.adopt("mem://r02", "r02")
+        assert mgr.get("r02").state == "refused"
+        assert [h.replica_id for h in mgr.routable()] == ["r01"]
+        # matching build still admits
+        http.set("mem://r03/healthz", health("r03"))
+        assert mgr.adopt("mem://r03", "r03").state == "admitted"
+
+    def test_poll_marks_exited_replica_dead(self):
+        mgr, http, fc = make_fleet({"r01": health("r01", qps=50.0)})
+        h = mgr.get("r01")
+        h.proc = FakeProc()
+        h.proc.returncode = -9  # process gone
+        http.set("mem://r01/healthz", ConnectionError("down"))
+        view = mgr.poll()
+        assert h.state == "dead"
+        assert view["by_state"] == {"dead": 1}
+        assert view["routable"] == 0
+
+    def test_fleet_view_aggregates_capacity_and_build(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, headroom=0.5),
+                "r02": health("r02", qps=40.0, headroom=0.8),
+            }
+        )
+        view = mgr.fleet_view()
+        assert view["routable"] == 2
+        assert view["fleet_capacity_qps"] == 140.0
+        assert view["expected_build"] == ["0.1", "abc"]
+        rows = {r["replica_id"]: r for r in view["replicas"]}
+        assert rows["r01"]["headroom"] == 0.5
+        assert rows["r02"]["build"]["config_hash"] == "abc"
+        assert view["policy"]["event_counts"] == {}
+
+
+class TestDrainAndKill:
+    def test_drain_completes_inflight_before_terminate(self):
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        h = mgr.get("r01")
+        inflight_at_sigterm = []
+        h.proc = FakeProc(
+            on_terminate=lambda: inflight_at_sigterm.append(h.in_flight)
+        )
+        mgr.note_inflight("r01", +2)
+        # each drain-loop sleep retires one in-flight request
+        orig_advance = fc.advance
+
+        def sleep(dt):
+            orig_advance(dt)
+            if h.in_flight:
+                mgr.note_inflight("r01", -1)
+
+        mgr.sleep = sleep
+        report = mgr.drain("r01", timeout_s=5.0)
+        assert report["drained_clean"] is True
+        assert h.state == "terminated"
+        # routing stopped first, SIGTERM only once nothing was in flight
+        assert inflight_at_sigterm == [0]
+        assert mgr.routable() == []
+
+    def test_drain_waits_for_replica_queue_depth(self):
+        # in-flight is zero but the replica still holds queued rows: drain
+        # must wait for the replica's own queue to empty before SIGTERM
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        h = mgr.get("r01")
+        h.proc = FakeProc()
+        http.set(
+            "mem://r01/healthz",
+            health("r01", queue=6),
+            health("r01", queue=0),
+        )
+        report = mgr.drain("r01", timeout_s=5.0)
+        assert report["drained_clean"] is True
+        assert h.state == "terminated" and h.proc.terminated
+
+    def test_drain_timeout_still_terminates_dirty(self):
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        h = mgr.get("r01")
+        h.proc = FakeProc()
+        mgr.note_inflight("r01", +1)  # never retires
+        report = mgr.drain("r01", timeout_s=1.0)
+        assert report["drained_clean"] is False
+        assert h.state == "terminated" and h.proc.terminated
+
+    def test_kill_reports_inflight_and_marks_dead(self):
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        h = mgr.get("r01")
+        h.proc = FakeProc(pid=777)
+        mgr.note_inflight("r01", +3)
+        report = mgr.kill("r01")
+        assert report == {
+            "replica_id": "r01",
+            "in_flight_at_kill": 3,
+            "pid": 777,
+        }
+        assert h.state == "dead" and h.proc.killed
+        with pytest.raises(ValueError, match="state dead"):
+            mgr.drain("r01")
+
+
+# ---------------------------------------------------------------------------
+# router: headroom ordering, freshness, failover budget
+# ---------------------------------------------------------------------------
+
+
+def ok_post(rid):
+    return (200, {"X-Replica-Id": rid}, json.dumps({"rid": rid}).encode())
+
+
+class TestRouterOrdering:
+    def test_route_prefers_most_predicted_headroom(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=1.0),
+                "r02": health("r02", qps=10.0, age=1.0),
+            }
+        )
+        post = ScriptedPost(
+            {"mem://r01/attack": ok_post("r01"), "mem://r02/attack": ok_post("r02")}
+        )
+        router = Router(mgr, http_post=post, clock=fc)
+        status, headers, _ = router.route(b"{}")
+        assert status == 200
+        assert headers["X-Served-By"] == "r01"  # 100-0 beats 10-0
+        assert headers["X-Fleet-Attempts"] == "1"
+        # live load flips the ranking: 100-95 < 10-0
+        mgr.note_inflight("r01", +95)
+        _, headers, _ = router.route(b"{}")
+        assert headers["X-Served-By"] == "r02"
+        assert router.counters_snapshot()["forwards"] == 2
+        # forwards resolved: in-flight bookkeeping returned to baseline
+        assert mgr.get("r02").in_flight == 0
+
+    def test_stale_poll_degrades_to_round_robin(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=1.0),
+                "r02": health("r02", qps=10.0, age=1.0),
+            }
+        )
+        post = ScriptedPost(
+            {"mem://r01/attack": ok_post("r01"), "mem://r02/attack": ok_post("r02")}
+        )
+        router = Router(mgr, http_post=post, clock=fc, stale_after_s=10.0)
+        fc.advance(60.0)  # both polls stale: capacity no longer trusted
+        served = [router.route(b"{}")[1]["X-Served-By"] for _ in range(2)]
+        assert set(served) == {"r01", "r02"}  # alternating, not pinned
+
+    def test_aged_capacity_window_degrades_to_round_robin(self):
+        # fresh poll but the capacity window itself is old (an idle
+        # replica keeps publishing an aging window) — not trusted either
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=120.0),
+                "r02": health("r02", qps=10.0, age=120.0),
+            }
+        )
+        post = ScriptedPost(
+            {"mem://r01/attack": ok_post("r01"), "mem://r02/attack": ok_post("r02")}
+        )
+        router = Router(mgr, http_post=post, clock=fc, capacity_age_max_s=30.0)
+        served = [router.route(b"{}")[1]["X-Served-By"] for _ in range(2)]
+        assert set(served) == {"r01", "r02"}
+
+    def test_no_routable_replica_sheds(self):
+        mgr = ReplicaManager(http_get=ScriptedHTTP(), clock=FakeClock())
+        router = Router(mgr, http_post=ScriptedPost({}))
+        status, headers, body = router.route(b"{}")
+        assert status == 503
+        assert headers["X-Fleet-Attempts"] == "0"
+        assert json.loads(body)["error"] == "no routable replica"
+        assert router.counters_snapshot()["shed_no_replica"] == 1
+
+
+class TestRouterFailover:
+    def two_replica_router(self, r01_resp, r02_resp, **kw):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=1.0),
+                "r02": health("r02", qps=10.0, age=1.0),
+            }
+        )
+        post = ScriptedPost(
+            {"mem://r01/attack": r01_resp, "mem://r02/attack": r02_resp}
+        )
+        return Router(mgr, http_post=post, clock=fc, **kw), mgr, post
+
+    def test_connection_failure_fails_over_within_budget(self):
+        router, mgr, post = self.two_replica_router(
+            ConnectionRefusedError("dead"), ok_post("r02"), retry_budget=2
+        )
+        status, headers, _ = router.route(b"{}")
+        assert status == 200
+        assert headers["X-Served-By"] == "r02"
+        assert headers["X-Fleet-Attempts"] == "2"
+        c = router.counters_snapshot()
+        assert c["failover_connection_total"] == 1
+        assert c["failover_connection:r01"] == 1
+        assert c["retries"] == 1 and c["forwards"] == 1
+        # the failed forward's in-flight increment was rolled back
+        assert mgr.get("r01").in_flight == 0
+
+    def test_429_fails_over_and_exhaustion_surfaces_retry_after(self):
+        reject = lambda rid: (
+            429,
+            {"Retry-After": "1.500", "X-Replica-Id": rid},
+            json.dumps({"error": "queue full"}).encode(),
+        )
+        router, mgr, post = self.two_replica_router(
+            reject("r01"), reject("r02"), retry_budget=1
+        )
+        status, headers, body = router.route(b"{}")
+        assert status == 429
+        # budget 1 = one retry after the first attempt; both were tried
+        assert headers["X-Fleet-Attempts"] == "2"
+        assert len(post.calls) == 2
+        # the final upstream 429's honest Retry-After flows through
+        assert headers["Retry-After"] == "1.500"
+        c = router.counters_snapshot()
+        assert c["failover_rejected_total"] == 2
+        assert c["shed_budget_exhausted"] == 1
+        assert c["forwards"] == 0
+
+    def test_5xx_counts_failed_not_rejected(self):
+        router, mgr, post = self.two_replica_router(
+            (500, {}, b'{"error":"boom"}'), ok_post("r02"), retry_budget=2
+        )
+        status, headers, _ = router.route(b"{}")
+        assert status == 200 and headers["X-Served-By"] == "r02"
+        c = router.counters_snapshot()
+        assert c["failover_failed:r01"] == 1
+        assert "failover_rejected_total" not in c
+
+    @pytest.mark.parametrize("status", [400, 413, 504])
+    def test_client_and_deadline_errors_never_retry(self, status):
+        # 400/413 are the caller's problem; a 504 request's deadline is
+        # already spent — retrying any of them would double-spend work
+        router, mgr, post = self.two_replica_router(
+            (status, {}, b'{"error":"no"}'), ok_post("r02"), retry_budget=2
+        )
+        got, headers, _ = router.route(b"{}")
+        assert got == status
+        assert headers["X-Fleet-Attempts"] == "1"
+        assert len(post.calls) == 1
+        assert router.counters_snapshot()["retries"] == 0
+
+
+class TestRouterAggregation:
+    def make_tracker(self, values, bounds=(0.1, 1.0), shed=0):
+        st = SloTracker(bounds=bounds)
+        for v in values:
+            st.observe("lcld", "dispatch", v)
+        for _ in range(shed):
+            st.shed("lcld", "expired", "queue_wait")
+        return st
+
+    def test_healthz_metrics_and_prometheus(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=1.0),
+                "r02": health("r02", qps=40.0, age=1.0),
+            }
+        )
+        s1 = self.make_tracker([0.05, 0.5], shed=1).snapshot()
+        s2 = self.make_tracker([0.05, 0.5]).snapshot()
+        http.set("mem://r01/metrics", {"replica_id": "r01", "slo": s1})
+        http.set("mem://r02/metrics", {"replica_id": "r02", "slo": s2})
+        post = ScriptedPost(
+            {"mem://r01/attack": ok_post("r01"), "mem://r02/attack": ok_post("r02")}
+        )
+        router = Router(mgr, http_post=post, clock=fc)
+        router.route(b"{}")
+
+        hz = router.healthz()
+        assert hz["ok"] is True
+        assert hz["fleet"]["routable"] == 2
+        assert hz["router"]["counters"]["forwards"] == 1
+        assert set(hz["replicas"]) == {"r01", "r02"}
+
+        snap = router.metrics()
+        merged = snap["slo_merged"]
+        assert merged["merged_from"] == 2
+        assert merged["skipped_mismatched_bounds"] == 0
+        # cumulative buckets summed across replicas: 4 observations total
+        hist = merged["stages"]["lcld"]["dispatch"]
+        assert hist["count"] == 4
+        assert merged["shed"]["total"] == 1
+        assert set(snap["per_replica"]) == {"r01", "r02"}
+
+        text = router.prometheus_text()
+        assert "moeva2_fleet_routable_replicas 2" in text
+        assert 'router_events_total{event="forwards"} 1' in text
+        assert ":r01" not in text  # per-replica attributions stay JSON-side
+
+    def test_http_front_routes_and_aggregates(self):
+        mgr, http, fc = make_fleet({"r01": health("r01", qps=100.0, age=1.0)})
+        http.set("mem://r01/metrics", {"replica_id": "r01"})
+        post = ScriptedPost({"mem://r01/attack": ok_post("r01")})
+        router = Router(mgr, http_post=post, clock=fc)
+        httpd = serve_router(router, "127.0.0.1", 0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+        try:
+            req = urllib.request.Request(
+                base + "/attack", data=b'{"domain": "lcld"}'
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Served-By"] == "r01"
+                assert resp.headers["X-Fleet-Attempts"] == "1"
+            with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+                hz = json.loads(resp.read())
+            assert hz["ok"] is True and hz["fleet"]["routable"] == 1
+            with urllib.request.urlopen(
+                base + "/metrics?format=prom", timeout=10
+            ) as resp:
+                assert b"moeva2_fleet_routable_replicas 1" in resp.read()
+        finally:
+            httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SLO merge primitives (the router's /metrics aggregation contract)
+# ---------------------------------------------------------------------------
+
+
+class TestSloMerge:
+    def test_histogram_merge_sums_and_requantiles(self):
+        s1 = {"buckets": [[0.1, 5], [1.0, 10]], "sum": 2.0, "count": 10}
+        s2 = {"buckets": [[0.1, 0], [1.0, 10]], "sum": 8.0, "count": 10}
+        merged = merge_histogram_snapshots([s1, s2])
+        assert merged["buckets"] == [[0.1, 5], [1.0, 20]]
+        assert merged["count"] == 20 and merged["sum"] == 10.0
+        # p50 rank 10: cumulative 5 at 0.1 misses, 20 at 1.0 covers
+        assert merged["p50"] == 1.0 and merged["p99"] == 1.0
+
+    def test_histogram_merge_refuses_mismatched_bounds(self):
+        s1 = {"buckets": [[0.1, 5], [1.0, 10]], "sum": 2.0, "count": 10}
+        s3 = {"buckets": [[0.25, 5], [2.5, 10]], "sum": 2.0, "count": 10}
+        assert merge_histogram_snapshots([s1, s3]) is None
+
+    def test_slo_merge_counts_mismatched_families(self):
+        a = SloTracker(bounds=(0.1, 1.0))
+        b = SloTracker(bounds=(0.25, 2.5))  # different bucket scheme
+        for st in (a, b):
+            st.observe("lcld", "dispatch", 0.05)
+        merged = merge_slo_snapshots([a.snapshot(), b.snapshot()])
+        assert merged["skipped_mismatched_bounds"] == 1
+        assert merged["stages"] == {}  # the family was dropped, not mixed
+
+    def test_slo_merge_adds_sheds_across_replicas(self):
+        a, b = SloTracker(), SloTracker()
+        a.shed("lcld", "expired", "queue_wait")
+        b.shed("lcld", "expired", "queue_wait")
+        b.shed("lcld", "overrun", "submit")
+        merged = merge_slo_snapshots([a.snapshot(), b.snapshot()])
+        assert merged["shed"]["total"] == 3
+        assert merged["shed"]["by_domain"]["lcld"]["expired"]["queue_wait"] == 2
+
+
+# ---------------------------------------------------------------------------
+# capacity freshness fields + derived Retry-After (satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityFreshness:
+    def test_domain_block_publishes_age_and_span(self):
+        fc = FakeClock()
+        cm = CapacityModel(window=8, clock=fc)
+        cm.note_batch(
+            "lcld",
+            strategy="pgd|flip",
+            bucket=8,
+            budget=3,
+            requests=4,
+            rows=8,
+            run_s=0.5,
+            flops=None,
+        )
+        fc.advance(5.0)
+        block = cm.domain_block("lcld")
+        # age = now - the window's last dispatch; span = the window's own
+        # wall coverage — the router's two freshness signals
+        assert block["age_s"] == 5.0
+        assert block["window_span_s"] == 0.5
+
+    def test_retry_after_from_windowed_drain_rate(self):
+        fc = FakeClock()
+        cm = CapacityModel(clock=fc)
+        assert cm.retry_after_s(32) is None  # no live window yet
+        cm.note_batch(
+            "lcld",
+            strategy="pgd|flip",
+            bucket=8,
+            budget=3,
+            requests=8,
+            rows=8,
+            run_s=0.5,
+            flops=None,
+        )
+        # window drains 16 rows/s => 32 queued rows ~ 2 s
+        assert cm.retry_after_s(32) == pytest.approx(2.0)
+        assert cm.retry_after_s(0) == pytest.approx(0.001)  # floor
+        assert cm.retry_after_s(10**9) == pytest.approx(30.0)  # cap
+
+
+class TestDerivedRetryAfterHint:
+    def make_full_batcher(self, retry_after_fn=None, max_delay_s=0.01):
+        clock = FakeClock()
+        b = Microbatcher(
+            BucketMenu((8,)),
+            max_delay_s=max_delay_s,
+            max_queue_rows=4,
+            metrics=ServiceMetrics(),
+            clock=clock,
+            start=False,
+            retry_after_fn=retry_after_fn,
+        )
+        b.submit("k", lambda x: x, np.ones((4, 1)))  # fill the queue
+        return b
+
+    def reject(self, b):
+        with pytest.raises(QueueFull) as ei:
+            b.submit("k", lambda x: x, np.ones((1, 1)))
+        return ei.value.retry_after_s
+
+    def test_hint_prefers_capacity_prediction(self):
+        b = self.make_full_batcher(retry_after_fn=lambda rows: 2.5)
+        assert self.reject(b) == pytest.approx(2.5)
+
+    def test_hint_floored_by_next_flush_deadline(self):
+        # the device could drain instantly, but admission still waits for
+        # the flusher's next obligation — the hint is honest above both
+        b = self.make_full_batcher(retry_after_fn=lambda rows: 1e-4)
+        assert self.reject(b) == pytest.approx(0.01)
+
+    def test_hint_falls_back_without_prediction(self):
+        assert self.reject(self.make_full_batcher()) == pytest.approx(0.01)
+        b = self.make_full_batcher(retry_after_fn=lambda rows: None)
+        assert self.reject(b) == pytest.approx(0.01)
+
+    def test_broken_hint_never_turns_429_into_500(self):
+        def boom(rows):
+            raise RuntimeError("broken capacity hook")
+
+        b = self.make_full_batcher(retry_after_fn=boom)
+        assert self.reject(b) == pytest.approx(0.01)
+
+    def test_hint_wired_from_capacity_model(self):
+        fc = FakeClock()
+        cm = CapacityModel(clock=fc)
+        cm.note_batch(
+            "lcld",
+            strategy="pgd|flip",
+            bucket=8,
+            budget=3,
+            requests=8,
+            rows=16,
+            run_s=1.0,
+            flops=None,
+        )
+        b = self.make_full_batcher(retry_after_fn=cm.retry_after_s)
+        # 4 queued rows over a 16 rows/s window, above the 0.01 deadline
+        assert self.reject(b) == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# autoscaling-shaped policy hooks (observe + act)
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyHooks:
+    AUTOSCALE = {"enabled": True, "sustain_s": 5.0}
+
+    def test_disabled_policy_emits_nothing(self):
+        mgr, http, fc = make_fleet({"r01": health("r01", headroom=0.01)})
+        assert mgr.policy_tick(now=0.0) == []
+        assert mgr.policy_tick(now=100.0) == []
+
+    def test_sustained_headroom_exhaustion_counts_scale_up(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=10.0, headroom=0.02),
+                "r02": health("r02", qps=10.0, headroom=0.05),
+            },
+            autoscale=self.AUTOSCALE,
+        )
+        assert mgr.policy_tick(now=0.0) == []  # exhaustion observed, not yet sustained
+        events = mgr.policy_tick(now=6.0)
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["kind"] == "scale_up"
+        assert ev["cause"] == "headroom_exhausted"
+        assert ev["acted"] is False  # observe mode counts only
+        assert mgr.event_counts == {"scale_up:headroom_exhausted": 1}
+        assert len(mgr.routable()) == 2  # nothing was spawned
+        # one event per sustain window: the very next tick restarts the clock
+        assert mgr.policy_tick(now=7.0) == []
+
+    def test_recovered_headroom_resets_the_sustain_clock(self):
+        mgr, http, fc = make_fleet(
+            {"r01": health("r01", headroom=0.02)}, autoscale=self.AUTOSCALE
+        )
+        mgr.policy_tick(now=0.0)
+        # headroom recovers mid-window: the exhaustion streak is broken
+        mgr.get("r01").last_health = health("r01", headroom=0.5)
+        assert mgr.policy_tick(now=3.0) == []
+        mgr.get("r01").last_health = health("r01", headroom=0.02)
+        assert mgr.policy_tick(now=4.0) == []  # streak restarted at 4.0
+        assert mgr.policy_tick(now=8.0) == []
+        assert mgr.policy_tick(now=9.5)[0]["kind"] == "scale_up"
+
+    def test_sustained_idle_counts_scale_down_with_victim(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", headroom=0.99),
+                "r02": health("r02", headroom=0.98),
+            },
+            autoscale=self.AUTOSCALE,
+        )
+        mgr.note_inflight("r02", +3)  # r01 is the least-loaded victim
+        assert mgr.policy_tick(now=0.0) == []
+        events = mgr.policy_tick(now=6.0)
+        assert [e["kind"] for e in events] == ["scale_down"]
+        assert events[0]["cause"] == "sustained_idle"
+        assert events[0]["victim"] == "r01"
+        assert events[0]["acted"] is False
+        assert len(mgr.routable()) == 2  # observe mode: no drain performed
+
+    def test_act_mode_drains_the_idle_victim(self):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", headroom=0.99),
+                "r02": health("r02", headroom=0.99),
+            },
+            autoscale={**self.AUTOSCALE, "mode": "act", "min_replicas": 1},
+        )
+        mgr.policy_tick(now=0.0)
+        events = mgr.policy_tick(now=6.0)
+        assert events[0]["acted"] is True
+        victim = mgr.get(events[0]["victim"])
+        assert victim.state == "terminated"  # adopted: drain stops routing
+        assert len(mgr.routable()) == 1
+        # min_replicas floor: the survivor is never drained away
+        mgr.policy_tick(now=12.0)
+        assert mgr.policy_tick(now=20.0) == []
+        assert len(mgr.routable()) == 1
+
+
+# ---------------------------------------------------------------------------
+# slow tier: two real serve.py replicas, chaos kill, failover, drain
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_artifacts(tmp_path_factory):
+    """Same self-contained synthetic LCLD family the serving tests use —
+    duplicated here so the fleet module stays independently runnable."""
+    from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_lcld,
+        synth_lcld_schema,
+    )
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+
+    tmp = tmp_path_factory.mktemp("fleet_artifacts")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(64, cons.schema, seed=5)
+
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=2))
+    save_params(sur, str(tmp / "nn.msgpack"))
+
+    import joblib
+    from sklearn.preprocessing import MinMaxScaler
+
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    scaler = MinMaxScaler().fit(np.vstack([x, xl, xu]))
+    joblib.dump(scaler, tmp / "scaler.joblib")
+    return {
+        "pool": x,
+        "domain": {
+            "project_name": "lcld",
+            "norm": 2,
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": paths["features"],
+                "constraints": paths["constraints"],
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "system": {"mesh_devices": 0},
+        },
+    }
+
+
+@pytest.mark.slow
+class TestFleetSubprocess:
+    def test_failover_and_drain_over_real_replicas(
+        self, fleet_artifacts, tmp_path
+    ):
+        cfg = {
+            "domains": {"lcld": fleet_artifacts["domain"]},
+            "serving": {
+                "bucket_sizes": [4, 8],
+                "max_delay_s": 0.05,
+                "max_queue_rows": 256,
+                "request_timeout_s": 120.0,
+                "capacity_window": 64,
+            },
+            "system": {"jax_cache_dir": str(tmp_path / "jax_cache")},
+        }
+        config_path = tmp_path / "fleet_config.json"
+        config_path.write_text(json.dumps(cfg))
+
+        manager = ReplicaManager(
+            str(config_path),
+            prewarm=False,  # first requests pay the compiles; fine here
+            log_dir=str(tmp_path / "logs"),
+            boot_timeout_s=300.0,
+            poll_timeout_s=180.0,
+        )
+        try:
+            h1 = manager.add()
+            h2 = manager.add()
+            assert {h1.state, h2.state} == {"admitted"}
+            # both replicas share one build fingerprint (same config/version)
+            assert h1.fingerprint == h2.fingerprint == tuple(
+                manager.expected_build
+            )
+
+            router = Router(manager, retry_budget=2, request_timeout_s=180.0)
+            body = json.dumps(
+                {
+                    "domain": "lcld",
+                    "rows": fleet_artifacts["pool"][:2].tolist(),
+                    "attack": "pgd",
+                    "loss_evaluation": "flip",
+                    "eps": 0.2,
+                    "budget": 2,
+                }
+            ).encode()
+
+            status, headers, resp = router.route(body)
+            assert status == 200, resp[:300]
+            victim_id = headers["X-Served-By"]
+            # the replica stamps its own identity end-to-end
+            assert headers.get("X-Replica-Id") == victim_id
+            victim = manager.get(victim_id)
+            survivor = h2 if victim is h1 else h1
+            manager.poll()
+
+            # chaos: SIGKILL behind the manager's back — the router still
+            # believes the victim is admitted, so a forward can hit the
+            # dead socket and must fail over within the retry budget
+            victim.proc.kill()
+            victim.proc.wait(timeout=15)
+            for _ in range(2):  # round-robin puts the corpse first once
+                status, headers, resp = router.route(body)
+                assert status == 200, resp[:300]
+                assert headers["X-Served-By"] == survivor.replica_id
+            counters = router.counters_snapshot()
+            assert counters["failover_connection_total"] >= 1
+            assert counters.get(f"failover_connection:{victim_id}", 0) >= 1
+
+            # the next poll round notices the corpse; routing excludes it
+            manager.poll()
+            assert victim.state == "dead"
+            assert [h.replica_id for h in manager.routable()] == [
+                survivor.replica_id
+            ]
+
+            # graceful end: concurrent in-flight requests complete before
+            # the survivor's process is terminated
+            with ThreadPoolExecutor(2) as pool:
+                futs = [pool.submit(router.route, body) for _ in range(2)]
+                results = [f.result(timeout=300) for f in futs]
+            assert all(r[0] == 200 for r in results)
+            report = manager.drain(survivor.replica_id, timeout_s=60.0)
+            assert report["drained_clean"] is True
+            assert survivor.state == "terminated"
+            assert survivor.proc.poll() is not None
+        finally:
+            manager.close()

@@ -135,6 +135,27 @@ DEFAULT_MESH_THRESHOLD = 0.25
 #: the same 2.5x-noise-floor margin as the perf threshold.
 DEFAULT_OVERLAP_THRESHOLD = 0.25
 
+#: absolute floor on the FLEET record's knee-scaling ratio under --fleet:
+#: knee(N_hi) / (knee(N_lo) x N_hi/N_lo) — the acceptance criterion's
+#: ">= 0.8x linear from 1 -> 4 replicas".
+DEFAULT_FLEET_SCALING_FLOOR = 0.8
+
+#: absolute floor on every measured replica's AOT warm-start fraction
+#: (aot_hits / prewarmed executables) under --fleet — ">= 90% warm from
+#: the shared cache", the cross-process AOT cache made load-bearing.
+DEFAULT_FLEET_WARM_FLOOR = 0.9
+
+#: absolute floor on the chaos segment's recovery ratio (post-kill knee /
+#: the (N-1)-replica knee) under --fleet. The two knees are measured
+#: minutes apart on a shared host, so the floor sits below 1.0 by more
+#: than the sweep ladder's granularity.
+DEFAULT_FLEET_RECOVERY_FLOOR = 0.6
+
+#: relative knee-scaling-ratio drop vs the best FLEET baseline that fails
+#: under --fleet (trajectory gate on top of the absolute floor).
+DEFAULT_FLEET_THRESHOLD = 0.15
+
+
 #: o-columns tracked at each interior budget: o2 (misclassified) and o7
 #: (the full constrained-adversarial criterion) — the two the round-5
 #: adjudication pinned (0.199/0.080 @100).
@@ -977,6 +998,213 @@ def diff_series(
     return lines, regressed, entries
 
 
+def fleet_check(
+    paths: list[str],
+    *,
+    scaling_floor: float = DEFAULT_FLEET_SCALING_FLOOR,
+    warm_floor: float = DEFAULT_FLEET_WARM_FLOOR,
+    recovery_floor: float = DEFAULT_FLEET_RECOVERY_FLOOR,
+    threshold: float = DEFAULT_FLEET_THRESHOLD,
+) -> tuple[list[str], bool, list[dict]]:
+    """The --fleet gate over the committed ``FLEET_r*.json`` series.
+
+    Absolute gates on the LATEST record (the acceptance criteria are
+    targets, not trajectories — like ``--cold``'s ratio ceiling):
+    knee-scaling ratio >= ``scaling_floor``, every replica's AOT
+    warm-start fraction >= ``warm_floor``, and the chaos segment's shed
+    accounting — zero unaccounted losses, losses bounded by the dead
+    replica's in-flight-at-kill count, recovery ratio >=
+    ``recovery_floor``. A latest record that LOST any of these captures
+    (null scaling, missing warm evidence, absent chaos block) FAILS —
+    the gate must not be disarmable by dropping the measurement.
+    Relative gate: the scaling ratio must not drop more than
+    ``threshold`` vs the best earlier record. No records at all passes
+    trivially (the gate arms with the first committed FLEET record)."""
+    lines: list[str] = []
+    regressed = False
+    entries: list[dict] = []
+
+    def fail(metric: str, msg: str, **extra):
+        nonlocal regressed
+        regressed = True
+        lines.append(f"  fleet.{metric}: {msg} — FAIL")
+        entries.append(
+            {"metric": f"fleet.{metric}", "verdict": "regression", **extra}
+        )
+
+    def ok(metric: str, msg: str, **extra):
+        lines.append(f"  fleet.{metric}: {msg} — ok")
+        entries.append({"metric": f"fleet.{metric}", "verdict": "ok", **extra})
+
+    if not paths:
+        lines.append(
+            "  fleet: no FLEET_r*.json records — gate unarmed, passing"
+        )
+        return lines, False, entries
+    records = []
+    for p in paths:
+        doc = load_record(p)
+        rec = doc.get("fleet") if isinstance(doc, dict) else None
+        records.append((p, rec))
+    latest_path, latest = records[-1]
+    lines.append(f"  fleet: gating {latest_path}")
+    if not isinstance(latest, dict):
+        fail("record", f"{latest_path} carries no fleet payload (lost capture)")
+        return lines, regressed, entries
+
+    # -- absolute: knee scaling ------------------------------------------------
+    scaling = latest.get("scaling") or {}
+    ratio = scaling.get("linear_ratio")
+    knees = scaling.get("knee_by_replicas")
+    if not isinstance(ratio, (int, float)):
+        fail(
+            "scaling.linear_ratio",
+            f"null (knees {knees}) — a sweep that never measured a knee "
+            "at both replica counts proves nothing",
+        )
+    elif ratio < scaling_floor:
+        fail(
+            "scaling.linear_ratio",
+            f"{ratio:.3f} < floor {scaling_floor:g} (knees {knees})",
+            value=ratio,
+            floor=scaling_floor,
+        )
+    else:
+        ok(
+            "scaling.linear_ratio",
+            f"{ratio:.3f} >= floor {scaling_floor:g} (knees {knees})",
+            value=ratio,
+            floor=scaling_floor,
+        )
+
+    # -- absolute: per-replica AOT warm start ---------------------------------
+    warm = latest.get("warm") or {}
+    min_warm = warm.get("min_warm_fraction")
+    per_replica = warm.get("per_replica") or {}
+    if not isinstance(min_warm, (int, float)):
+        fail(
+            "warm.min_warm_fraction",
+            "missing — no per-replica AOT warm-start evidence",
+        )
+    elif min_warm < warm_floor:
+        worst = min(
+            per_replica.items(),
+            key=lambda kv: kv[1].get("warm_fraction") or 0,
+            default=(None, {}),
+        )
+        fail(
+            "warm.min_warm_fraction",
+            f"{min_warm:.2f} < floor {warm_floor:g} "
+            f"(worst replica {worst[0]}: {worst[1]})",
+            value=min_warm,
+            floor=warm_floor,
+        )
+    else:
+        ok(
+            "warm.min_warm_fraction",
+            f"{min_warm:.2f} >= floor {warm_floor:g} "
+            f"({len(per_replica)} replicas)",
+            value=min_warm,
+            floor=warm_floor,
+        )
+
+    # -- absolute: chaos shed accounting + recovery ---------------------------
+    chaos = latest.get("chaos")
+    if not isinstance(chaos, dict):
+        fail(
+            "chaos",
+            "no chaos segment — the kill-a-replica proof is the record's "
+            "point; a sweep that skipped it is not committable evidence",
+        )
+    else:
+        acct = chaos.get("shed_accounting") or {}
+        unaccounted = acct.get("lost_unaccounted")
+        lost = acct.get("lost_dead_replica")
+        in_flight = acct.get("in_flight_at_kill")
+        if unaccounted is None:
+            fail("chaos.lost_unaccounted", "missing shed accounting")
+        elif unaccounted != 0:
+            fail(
+                "chaos.lost_unaccounted",
+                f"{unaccounted} terminal failures attribute to NO dead "
+                "replica — the router shed something it didn't have to",
+                value=unaccounted,
+            )
+        else:
+            ok(
+                "chaos.lost_unaccounted",
+                f"0 (dead-replica losses {lost}, in-flight at kill "
+                f"{in_flight}, retried {acct.get('retried')})",
+                lost_dead_replica=lost,
+                in_flight_at_kill=in_flight,
+            )
+        if (
+            isinstance(lost, (int, float))
+            and isinstance(in_flight, (int, float))
+            and lost > in_flight
+        ):
+            fail(
+                "chaos.lost_dead_replica",
+                f"{lost} > in_flight_at_kill {in_flight} — losses exceed "
+                "what the dead replica could have held",
+                value=lost,
+                bound=in_flight,
+            )
+        recovery = (chaos.get("recovery") or {}).get("recovery_ratio")
+        if not isinstance(recovery, (int, float)):
+            fail(
+                "chaos.recovery_ratio",
+                "null — the post-kill sweep never recovered a knee",
+            )
+        elif recovery < recovery_floor:
+            fail(
+                "chaos.recovery_ratio",
+                f"{recovery:.3f} < floor {recovery_floor:g}",
+                value=recovery,
+                floor=recovery_floor,
+            )
+        else:
+            ok(
+                "chaos.recovery_ratio",
+                f"{recovery:.3f} >= floor {recovery_floor:g}",
+                value=recovery,
+                floor=recovery_floor,
+            )
+
+    # -- relative: scaling trajectory vs best baseline ------------------------
+    baselines = [
+        (p, (r.get("scaling") or {}).get("linear_ratio"))
+        for p, r in records[:-1]
+        if isinstance(r, dict)
+    ]
+    best = max(
+        (b for b in baselines if isinstance(b[1], (int, float))),
+        key=lambda b: b[1],
+        default=None,
+    )
+    if best is not None and isinstance(ratio, (int, float)):
+        rel = (best[1] - ratio) / best[1] if best[1] > 0 else 0.0
+        if rel > threshold:
+            fail(
+                "scaling.linear_ratio (vs baseline)",
+                f"{ratio:.3f} vs best {best[1]:.3f} ({best[0]}): "
+                f"-{rel:.0%} > {threshold:.0%}",
+                value=ratio,
+                baseline=best[1],
+                delta_rel=-rel,
+            )
+        else:
+            ok(
+                "scaling.linear_ratio (vs baseline)",
+                f"{ratio:.3f} vs best {best[1]:.3f} ({best[0]}): "
+                f"{-rel:+.0%} within {threshold:.0%}",
+                value=ratio,
+                baseline=best[1],
+                delta_rel=-rel,
+            )
+    return lines, regressed, entries
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -1070,6 +1298,45 @@ def main(argv=None) -> int:
         f"(default {DEFAULT_COLD_MAX_RATIO})",
     )
     parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="also gate the committed FLEET_r*.json series (globbed in "
+        "cwd): knee-scaling ratio and per-replica AOT warm fraction "
+        "against absolute floors, the chaos segment's shed accounting "
+        "(zero unaccounted losses, losses bounded by in-flight-at-kill, "
+        "recovery to the (N-1)-replica knee), and the scaling ratio's "
+        "trajectory vs the best baseline. Lost capture fails; no FLEET "
+        "records passes (the gate arms with the first)",
+    )
+    parser.add_argument(
+        "--fleet-scaling-floor",
+        type=float,
+        default=DEFAULT_FLEET_SCALING_FLOOR,
+        help="absolute knee-scaling-ratio floor under --fleet "
+        f"(default {DEFAULT_FLEET_SCALING_FLOOR})",
+    )
+    parser.add_argument(
+        "--fleet-warm-floor",
+        type=float,
+        default=DEFAULT_FLEET_WARM_FLOOR,
+        help="absolute per-replica AOT warm-fraction floor under --fleet "
+        f"(default {DEFAULT_FLEET_WARM_FLOOR})",
+    )
+    parser.add_argument(
+        "--fleet-recovery-floor",
+        type=float,
+        default=DEFAULT_FLEET_RECOVERY_FLOOR,
+        help="absolute chaos recovery-ratio floor under --fleet "
+        f"(default {DEFAULT_FLEET_RECOVERY_FLOOR})",
+    )
+    parser.add_argument(
+        "--fleet-threshold",
+        type=float,
+        default=DEFAULT_FLEET_THRESHOLD,
+        help="relative scaling-ratio drop vs the best FLEET baseline that "
+        f"fails under --fleet (default {DEFAULT_FLEET_THRESHOLD})",
+    )
+    parser.add_argument(
         "--json",
         action="store_true",
         help="append one machine-readable JSON line (per-metric basis, "
@@ -1081,7 +1348,23 @@ def main(argv=None) -> int:
     paths = list(args.records)
     if not paths and args.check:
         paths = sorted(glob.glob("BENCH_r*.json"))
-    if not paths:
+
+    # the FLEET series is its own file family (FLEET_r*.json, globbed in
+    # cwd like the --check default) gated independently of the BENCH
+    # series — a fleet-only invocation needs no BENCH records at all
+    fleet_lines: list[str] = []
+    fleet_regressed = False
+    fleet_entries: list[dict] = []
+    if args.fleet:
+        fleet_lines, fleet_regressed, fleet_entries = fleet_check(
+            sorted(glob.glob("FLEET_r*.json")),
+            scaling_floor=args.fleet_scaling_floor,
+            warm_floor=args.fleet_warm_floor,
+            recovery_floor=args.fleet_recovery_floor,
+            threshold=args.fleet_threshold,
+        )
+
+    if not paths and not args.fleet:
         parser.error("no bench records given (and --check found none)")
 
     # records are taken in the order GIVEN (oldest first, per the CLI
@@ -1098,14 +1381,25 @@ def main(argv=None) -> int:
             f"bench_diff: {len(records)} usable record(s) — nothing to "
             "diff, trivially passing"
         )
+        if fleet_lines:
+            print("fleet gate:")
+            print("\n".join(fleet_lines))
+            print(
+                "bench_diff: fleet REGRESSION — failing"
+                if fleet_regressed
+                else "bench_diff: fleet ok"
+            )
         if args.json:
             print(
                 json.dumps(
-                    {"regressed": False, "reason": "insufficient_records",
-                     "usable_records": len(records), "metrics": []}
+                    {"regressed": fleet_regressed,
+                     "reason": "insufficient_records",
+                     "usable_records": len(records),
+                     "fleet": args.fleet,
+                     "metrics": fleet_entries}
                 )
             )
-        return 0
+        return 1 if fleet_regressed else 0
 
     print(
         f"bench_diff: {records[-1][0]} vs {len(records) - 1} earlier "
@@ -1126,6 +1420,11 @@ def main(argv=None) -> int:
         cold_max_ratio=args.cold_max_ratio,
     )
     print("\n".join(lines))
+    if fleet_lines:
+        print("fleet gate:")
+        print("\n".join(fleet_lines))
+    regressed = regressed or fleet_regressed
+    entries = entries + fleet_entries
     if regressed:
         print("bench_diff: REGRESSION past threshold — failing")
     else:
@@ -1148,6 +1447,11 @@ def main(argv=None) -> int:
                     "overlap_threshold": args.overlap_threshold,
                     "cold": args.cold,
                     "cold_max_ratio": args.cold_max_ratio,
+                    "fleet": args.fleet,
+                    "fleet_scaling_floor": args.fleet_scaling_floor,
+                    "fleet_warm_floor": args.fleet_warm_floor,
+                    "fleet_recovery_floor": args.fleet_recovery_floor,
+                    "fleet_threshold": args.fleet_threshold,
                     "regressed": regressed,
                     "metrics": entries,
                 }

@@ -1,0 +1,162 @@
+"""Run an attack-service fleet: N serve.py replicas behind the capacity router.
+
+Quickstart (after ``python tools/bootstrap_lcld.py`` for the LCLD domain)::
+
+    python tools/fleet.py -c config/serving.yaml --replicas 2
+    python tools/loadgen.py --url http://127.0.0.1:8700 --domain lcld \
+        --requests 64 --concurrency 8
+
+Then::
+
+    curl -s localhost:8700/healthz        # fleet view + per-replica blocks
+    curl -s localhost:8700/metrics        # merged SLO + per-replica metrics
+    curl -s 'localhost:8700/metrics?format=prom'
+
+Replicas are spawned with ``--port 0 --replica-id rNN`` over ONE shared
+config — and thereby one shared AOT/artifact cache directory, so replica
+#N boots as warm as #1. The router admits each replica only after its
+first healthy /healthz poll with a matching build fingerprint, forwards
+/attack to the replica with the most predicted headroom, and fails over
+rejected/failed forwards within a bounded retry budget. SIGINT drains
+every replica (in-flight requests complete) before exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "-c", default="config/serving.yaml", help="serving config yaml"
+    )
+    parser.add_argument("--host", default="127.0.0.1", help="router bind host")
+    parser.add_argument(
+        "--port", type=int, default=None, help="router port (default fleet.port)"
+    )
+    parser.add_argument(
+        "--replicas", type=int, default=None, help="override fleet.replicas"
+    )
+    parser.add_argument(
+        "--no-prewarm",
+        action="store_true",
+        help="spawn replicas without --prewarm (first requests pay "
+        "compiles/AOT loads)",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true", help="access log")
+    args = parser.parse_args(argv)
+
+    from moeva2_ijcai22_replication_tpu.serving.fleet import (
+        ReplicaManager,
+        Router,
+        serve_router,
+    )
+    from moeva2_ijcai22_replication_tpu.utils.config import load_config_file
+
+    cfg = load_config_file(args.c)
+    fleet_cfg = cfg.get("fleet", {}) or {}
+    n = args.replicas if args.replicas is not None else fleet_cfg.get("replicas", 2)
+    port = args.port if args.port is not None else fleet_cfg.get("port", 8700)
+
+    manager = ReplicaManager(
+        args.c,
+        prewarm=not args.no_prewarm,
+        log_dir=fleet_cfg.get("log_dir"),
+        boot_timeout_s=fleet_cfg.get("boot_timeout_s", 600.0),
+        autoscale=fleet_cfg.get("autoscale"),
+    )
+    router = Router(
+        manager,
+        retry_budget=fleet_cfg.get("retry_budget", 2),
+        stale_after_s=fleet_cfg.get("stale_after_s", 10.0),
+        capacity_age_max_s=fleet_cfg.get("capacity_age_max_s", 30.0),
+        request_timeout_s=cfg.get("serving", {}).get("request_timeout_s", 60.0)
+        + 30.0,
+    )
+    try:
+        for _ in range(int(n)):
+            handle = manager.add()
+            print(
+                f"fleet: admitted {handle.replica_id} at {handle.url} "
+                f"(pid {getattr(handle.proc, 'pid', None)})",
+                flush=True,
+            )
+    except Exception:
+        manager.close()
+        raise
+
+    # background poll + policy loop: keeps the routing signal fresh and
+    # drives the autoscaling-shaped hooks (observe-mode by default)
+    poll_interval = float(fleet_cfg.get("poll_interval_s", 2.0))
+    stop = threading.Event()
+
+    def poll_loop():
+        while not stop.wait(poll_interval):
+            manager.poll()
+            manager.policy_tick()
+
+    threading.Thread(target=poll_loop, daemon=True).start()
+
+    httpd = serve_router(router, args.host, port, verbose=args.verbose)
+    bound = httpd.server_address
+    print(
+        f"fleet router on http://{bound[0]}:{bound[1]} "
+        f"({n} replicas; retry budget {router.retry_budget})",
+        flush=True,
+    )
+    # supervisors (systemd, k8s) stop services with SIGTERM; without a
+    # handler the default action kills this process before the drain
+    # below runs and the replica children are orphaned
+    def _on_sigterm(_signum, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        httpd.shutdown()
+        print("fleet: draining replicas...", flush=True)
+        for handle in manager.routable():
+            report = manager.drain(handle.replica_id)
+            print(
+                f"fleet: drained {report['replica_id']} "
+                f"(clean={report['drained_clean']}, {report['drain_s']}s)",
+                flush=True,
+            )
+        manager.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+
+
+# convenience: wait for a replica set to go healthy from a script
+def wait_healthy(url: str, timeout_s: float = 60.0) -> dict:
+    """Poll a router /healthz until ok (tiny helper for scripts/tests)."""
+    import json
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=5) as r:
+                last = json.loads(r.read())
+            if last.get("ok"):
+                return last
+        except Exception:  # noqa: BLE001 — still booting
+            pass
+        time.sleep(0.25)
+    raise TimeoutError(f"router at {url} not healthy within {timeout_s}s: {last}")

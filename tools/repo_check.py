@@ -54,7 +54,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = {
     "bench_diff": (
         "bench_diff.py",
-        ["--check", "--slo", "--mesh", "--overlap", "--cold"],
+        ["--check", "--slo", "--mesh", "--overlap", "--cold", "--fleet"],
     ),
     "shard_lint": ("shard_lint.py", ["--check"]),
 }

@@ -29,7 +29,20 @@ def main(argv=None) -> int:
         "-c", default="config/serving.yaml", help="serving config yaml"
     )
     parser.add_argument("--host", default=None, help="override serving.host")
-    parser.add_argument("--port", type=int, default=None, help="override serving.port")
+    parser.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="override serving.port (0 = auto-assign an ephemeral port; "
+        "the bound port is reported on stdout and /healthz)",
+    )
+    parser.add_argument(
+        "--replica-id",
+        default=None,
+        help="fleet label threaded into /healthz, /metrics, trace ids and "
+        "the X-Replica-Id response header (set by serving.fleet's "
+        "ReplicaManager when it spawns replicas)",
+    )
     parser.add_argument("-v", "--verbose", action="store_true", help="access log")
     parser.add_argument(
         "--prewarm",
@@ -72,6 +85,7 @@ def main(argv=None) -> int:
         recorder=recorder,
         slo_buckets=srv_cfg.get("slo_histogram_buckets"),
         capacity_window=srv_cfg.get("capacity_window", 256),
+        replica_id=args.replica_id,
     )
     # boot-time prewarm: BEFORE the HTTP front binds, so the first caller
     # never pays a compile (engines are single-dispatch objects — this
@@ -96,6 +110,24 @@ def main(argv=None) -> int:
         verbose=args.verbose,
     )
     bound = httpd.server_address
+    # machine-readable readiness line FIRST (one JSON object, one line):
+    # the fleet ReplicaManager tails stdout for it to learn the bound port
+    # under --port 0 without any port bookkeeping
+    import json as _json
+
+    print(
+        _json.dumps(
+            {
+                "fleet_ready": {
+                    "url": f"http://{bound[0]}:{bound[1]}",
+                    "host": bound[0],
+                    "port": bound[1],
+                    "replica_id": args.replica_id,
+                }
+            }
+        ),
+        flush=True,
+    )
     print(
         f"attack service on http://{bound[0]}:{bound[1]} "
         f"(domains: {', '.join(sorted(cfg['domains']))}; "

@@ -343,8 +343,10 @@ class Moeva2:
             # are runtime arguments), so the persistent AOT cache needs a
             # process-independent field discriminating domains of equal
             # shape — the engine-cache slot id above hashes object id()s
-            # and cannot serve across processes
-            "constraints": type(self.constraints).__name__,
+            # and cannot serve across processes; spec-compiled domains
+            # discriminate by spec hash (ledger_tag), hand-written ones by
+            # class name exactly as before
+            "constraints": self.constraints.ledger_tag,
             "n_features": self.codec.n_features,
             "n_constraints": self.constraints.get_nb_constraints(),
             "norm": str(self.norm),

@@ -111,8 +111,9 @@ class ConstrainedPGD:
             "cache_key": getattr(self, "cache_key", None),
             # stable domain identity for the persistent AOT cache: the
             # constraint formulas are traced into the executable, and the
-            # engine-cache slot id above is id()-derived (process noise)
-            "constraints": type(self.constraints).__name__,
+            # engine-cache slot id above is id()-derived (process noise);
+            # spec-compiled domains discriminate by spec hash (ledger_tag)
+            "constraints": self.constraints.ledger_tag,
             "n_constraints": int(self.constraints.n_constraints),
             "loss_evaluation": self.loss_evaluation,
             "constraints_optim": self.constraints_optim,

@@ -100,6 +100,18 @@ class ConstraintSet:
             )
 
     # -- metadata (delegates to the schema) --------------------------------
+    @property
+    def ledger_tag(self) -> str:
+        """Cache/ledger identity of this constraint set.
+
+        Hand-written domains identify by class name (byte-identical to the
+        pre-IR ledger keys); spec-compiled domains override the instance
+        attribute with ``spec:<name>:<hash12>`` so two processes serving the
+        same spec revision share AOT executables while a spec edit is a new
+        identity, never a stale hit.
+        """
+        return getattr(self, "_ledger_tag", None) or type(self).__name__
+
     def get_mutable_mask(self) -> np.ndarray:
         return np.asarray(self.schema.mutable)
 

@@ -1,11 +1,33 @@
 """Use-case domain plugins and their registry.
 
 Parity: the reference's project-name -> constraint-class lookup
-(``/root/reference/src/experiments/united/utils.py:12-30``).
+(``/root/reference/src/experiments/united/utils.py:12-30``) — extended into
+a real registry serving three origins:
+
+- ``handwritten`` — the original jnp classes (``lcld``, ``botnet``, and
+  their augmented variants). Their registry names, classes, and ledger
+  identities are unchanged.
+- ``spec`` — domains compiled from the declarative constraint IR
+  (:mod:`.ir`): the committed re-expressions ``lcld_spec``/``botnet_spec``
+  (bit-compatible with their hand-written twins) and data-only domains
+  like ``phishing`` that exist *only* as a spec.
+- ``generated`` — seeded synthetic families, ``family<seed>``, compiled on
+  first lookup from :func:`.ir.generate_family`.
+
+:func:`domain_origin` reports ``{origin, spec_hash}`` per registered name —
+the provenance record ``/healthz`` exposes per served domain.
 """
+
+from __future__ import annotations
+
+import os
+import re
 
 from .lcld import LcldConstraints, LcldAugmentedConstraints
 from .botnet import BotnetConstraints, BotnetAugmentedConstraints
+from .ir import compile_spec, generate_family, load_spec, spec_hash
+
+SPEC_DIR = os.path.join(os.path.dirname(__file__), "specs")
 
 CONSTRAINTS_REGISTRY = {
     "lcld": LcldConstraints,
@@ -14,14 +36,65 @@ CONSTRAINTS_REGISTRY = {
     "botnet_augmented": BotnetAugmentedConstraints,
 }
 
+#: committed spec-front domains: registry name -> spec file under SPEC_DIR
+SPEC_DOMAINS = {
+    "lcld_spec": "lcld.yaml",
+    "botnet_spec": "botnet.yaml",
+    "phishing": os.path.join("phishing", "constraints.csv"),
+}
+
+_GENERATED_RE = re.compile(r"family(\d+)$")
+
+
+def spec_domain_dir(project_name: str) -> str:
+    """Directory of a committed spec domain's package data (where a data-only
+    domain like phishing keeps its ``features.csv``/``constraints.csv``)."""
+    rel = SPEC_DOMAINS[project_name]
+    return os.path.dirname(os.path.join(SPEC_DIR, rel)) or SPEC_DIR
+
+
+def register_spec_domain(name: str, spec_path: str) -> type:
+    """Compile a spec file and register it under ``name`` (idempotent for an
+    unchanged spec; recompiles — new class, new ledger identity — when the
+    file changed)."""
+    cls = compile_spec(load_spec(spec_path, name=name))
+    CONSTRAINTS_REGISTRY[name] = cls
+    return cls
+
 
 def get_constraints_class(project_name: str):
     try:
         return CONSTRAINTS_REGISTRY[project_name]
     except KeyError:
-        raise ValueError(
-            f"Unknown project {project_name!r}; known: {sorted(CONSTRAINTS_REGISTRY)}"
-        ) from None
+        pass
+    if project_name in SPEC_DOMAINS:
+        return register_spec_domain(
+            project_name, os.path.join(SPEC_DIR, SPEC_DOMAINS[project_name])
+        )
+    m = _GENERATED_RE.fullmatch(project_name)
+    if m:
+        _, _, spec, _ = generate_family(int(m.group(1)))
+        cls = compile_spec(spec)
+        cls.origin = "generated"
+        CONSTRAINTS_REGISTRY[project_name] = cls
+        return cls
+    raise ValueError(
+        f"Unknown project {project_name!r}; known: "
+        f"{sorted(set(CONSTRAINTS_REGISTRY) | set(SPEC_DOMAINS))} "
+        "(plus generated family<seed> domains)"
+    ) from None
+
+
+def domain_origin(project_name: str) -> dict:
+    """Provenance of a registered domain: ``{"origin": handwritten|spec|
+    generated, "spec_hash": <sha256> | None}``."""
+    cls = get_constraints_class(project_name)
+    origin = getattr(cls, "origin", "handwritten")
+    spec = getattr(cls, "spec", None)
+    return {
+        "origin": origin,
+        "spec_hash": spec_hash(spec) if spec is not None else None,
+    }
 
 
 __all__ = [
@@ -30,5 +103,10 @@ __all__ = [
     "BotnetConstraints",
     "BotnetAugmentedConstraints",
     "CONSTRAINTS_REGISTRY",
+    "SPEC_DOMAINS",
+    "SPEC_DIR",
+    "domain_origin",
     "get_constraints_class",
+    "register_spec_domain",
+    "spec_domain_dir",
 ]

@@ -1,0 +1,60 @@
+"""Constraint-expression IR and domain compiler: domain-as-data.
+
+One declarative spec (YAML or a ``constraints.csv`` grown an ``expr``
+column) compiles into everything the pipeline previously required three
+hand-written implementations for:
+
+- a vectorized jnp penalty-terms kernel (:func:`compile_spec` ->
+  :class:`SpecConstraintSet`), bit-compatible with the hand-written domains
+  it re-expresses;
+- a HiGHS MILP row builder for the SAT/repair attack
+  (:func:`make_spec_sat_builder`);
+- an in-graph constructive repair projection derived from the defining
+  equalities (:mod:`.repair_backend`, wired into the compiled class).
+
+See ``DESIGN.md`` § "Constraint IR & domain compiler" and the README's
+five-step onboarding walkthrough.
+"""
+
+from .expr import Constraint, Env, SpecError, parse_constraint, parse_expr
+from .generator import generate_family, sample_family, write_family
+from .jnp_backend import SpecConstraintSet, compile_spec, compile_spec_path
+from .milp_backend import SpecMilpError, make_spec_sat_builder
+from .ops import finite_div, months, safe_div
+from .spec import (
+    ConstraintSpec,
+    ResolvedSpec,
+    load_spec,
+    load_spec_csv,
+    load_spec_yaml,
+    resolve_spec,
+    spec_hash,
+    validate_spec,
+)
+
+__all__ = [
+    "Constraint",
+    "ConstraintSpec",
+    "Env",
+    "ResolvedSpec",
+    "SpecConstraintSet",
+    "SpecError",
+    "SpecMilpError",
+    "compile_spec",
+    "compile_spec_path",
+    "finite_div",
+    "generate_family",
+    "load_spec",
+    "load_spec_csv",
+    "load_spec_yaml",
+    "make_spec_sat_builder",
+    "months",
+    "parse_constraint",
+    "parse_expr",
+    "resolve_spec",
+    "safe_div",
+    "sample_family",
+    "spec_hash",
+    "validate_spec",
+    "write_family",
+]

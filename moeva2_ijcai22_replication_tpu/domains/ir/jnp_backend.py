@@ -1,0 +1,126 @@
+"""jnp compiler backend: spec -> vectorized penalty-terms kernel.
+
+:func:`compile_spec` turns a :class:`~.spec.ConstraintSpec` into a
+:class:`~...core.constraints.ConstraintSet` subclass whose ``_raw`` emits
+the same per-element op sequences as the hand-written kernels (see
+:mod:`.expr`), so a committed spec reproduces ``lcld_constraint_terms`` /
+``BotnetConstraints._raw`` bit for bit on the same inputs.
+
+Trace stability / cache identity: every compiled class is a distinct Python
+type, but the engines' ``_ledger_identity`` and the AOT-cache keys
+discriminate by :attr:`ConstraintSet.ledger_tag` — which compiled sets
+override with ``spec:<name>:<hash12>`` — so two processes serving the same
+spec revision share executables while a spec edit is a new identity, not a
+silent stale hit.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.codec import full_ohe_tables
+from ...core.constraints import ConstraintSet
+from ...core.schema import ConstraintBounds, FeatureSchema
+from .expr import eval_term
+from .repair_backend import compile_repair
+from .spec import ConstraintSpec, ResolvedSpec, load_spec, resolve_spec
+
+
+def raw_terms(x, resolved: ResolvedSpec, xp):
+    """Unthresholded violation magnitudes ``(..., n_terms)``.
+
+    All-scalar specs stack (the lcld shape); mixed scalar/group specs
+    concatenate with scalars expanded ``[..., None]`` (the botnet shape) —
+    matching the hand-written kernels' assembly, and either way leaving the
+    per-element values untouched.
+    """
+    vals = []
+    for c, w in zip(resolved.spec.constraints, resolved.widths):
+        v, vw = eval_term(c, x, resolved.env, xp)
+        if vw == 0:  # degenerate literal-only constraint: broadcast per row
+            v = v + 0.0 * x[..., 0]
+        vals.append((v, max(vw, 1)))
+    if all(w == 1 for _, w in vals):
+        return xp.stack([v for v, _ in vals], axis=-1)
+    return xp.concatenate(
+        [v[..., None] if w == 1 else v for v, w in vals], axis=-1
+    )
+
+
+class SpecConstraintSet(ConstraintSet):
+    """A constraint set compiled from a declarative spec.
+
+    Constructor signature matches the hand-written domain classes
+    (``(features_path, constraints_path)``), so the registry and
+    ``load_constraints`` treat compiled and hand-written domains uniformly.
+    ``constraints_path`` may be None/"" for spec families without committed
+    violation-normalisation bounds.
+    """
+
+    #: the compiled spec — set per subclass by :func:`compile_spec`
+    spec: ConstraintSpec = None
+    origin = "spec"
+
+    def __init__(
+        self,
+        features_path: str,
+        constraints_path: str | None = None,
+        important_features_path: str | None = None,
+    ):
+        if self.spec is None:
+            raise TypeError(
+                "SpecConstraintSet is abstract; build a subclass with "
+                "compile_spec(spec)"
+            )
+        schema = FeatureSchema.from_csv(features_path)
+        bounds = (
+            ConstraintBounds.from_csv(constraints_path)
+            if constraints_path
+            else None
+        )
+        data_dir = os.path.dirname(os.path.abspath(features_path))
+        resolved = resolve_spec(self.spec, schema, data_dir)
+        # instance attr must exist before super().__init__ runs its
+        # bounds-row count check (n_constraints is group-resolution
+        # dependent, so it cannot be a class attribute)
+        self.n_constraints = resolved.n_terms
+        super().__init__(schema, bounds)
+        self.resolved = resolved
+        self._ledger_tag = f"spec:{self.spec.name}:{resolved.hash[:12]}"
+        self.important_features = (
+            np.load(important_features_path)
+            if important_features_path and os.path.exists(important_features_path)
+            else None
+        )
+        self._ohe_idx, self._ohe_mask = full_ohe_tables(schema)
+        self._repair_fn = compile_repair(
+            resolved, schema, self._ohe_idx, self._ohe_mask
+        )
+
+    def _raw(self, x: jnp.ndarray) -> jnp.ndarray:
+        return raw_terms(x, self.resolved, jnp)
+
+    def raw_numpy(self, x: np.ndarray) -> np.ndarray:
+        """The numpy oracle twin of ``_raw`` — same AST, numpy ufuncs."""
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.asarray(raw_terms(np.asarray(x), self.resolved, np))
+
+    def repair(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self._repair_fn(x)
+
+
+def compile_spec(spec: ConstraintSpec) -> type:
+    """Spec -> ConstraintSet subclass (instantiate with the usual
+    ``(features_path, constraints_path)``)."""
+    return type(
+        f"Spec_{spec.name}",
+        (SpecConstraintSet,),
+        {"spec": spec, "__module__": __name__},
+    )
+
+
+def compile_spec_path(path: str, name: str | None = None) -> type:
+    return compile_spec(load_spec(path, name=name))

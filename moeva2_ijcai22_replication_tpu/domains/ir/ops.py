@@ -1,0 +1,57 @@
+"""Shared numeric operator definitions for the constraint IR.
+
+Every operator the reference's constraint language uses more than once is
+defined exactly once here, with a numpy/jnp dispatch on the input type —
+the jnp backend, the numpy twin evaluator, the feasible-sample generators,
+and the hand-written kernels all call the same definitions, so "the jnp
+kernel and the numpy oracle agree" is true by construction rather than by
+parallel maintenance.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _xp(value):
+    """numpy for host values (arrays, numpy scalars, python numbers), jnp
+    for traced/device arrays — keeps host-side generators in float64 while
+    kernels trace under the active jax dtype mode."""
+    if isinstance(value, (np.ndarray, np.generic, float, int)):
+        return np
+    return jnp
+
+
+def months(date_feature):
+    """YYYYMM integer-coded date -> month count: floor(f/100)*12 + f mod 100.
+
+    The reference defines this twice (``lcld_constraints.py`` numpy oracle
+    and TF twin); this repo previously did too (``domains/lcld.py`` jnp,
+    ``domains/synth.py`` numpy). This is now the only definition.
+    """
+    xp = _xp(date_feature)
+    return xp.floor(date_feature / 100.0) * 12.0 + xp.mod(date_feature, 100.0)
+
+
+def safe_div(num, den, sentinel):
+    """Guarded division: ``num / den`` where ``den != 0``, else ``sentinel``.
+
+    Exactly the botnet ratio guard (``domains/botnet.py``): one mask, the
+    denominator substituted by 1 under the mask so the division itself never
+    produces inf/nan on the guarded lanes.
+    """
+    xp = _xp(den)
+    ok = den != 0
+    return xp.where(ok, num / xp.where(ok, den, 1.0), sentinel)
+
+
+def finite_div(num, den, sentinel):
+    """``safe_div`` plus a non-finite snap: any inf/nan result also maps to
+    ``sentinel``. Exactly the LCLD g10 masked-array dance
+    (``domains/lcld.py``): 0/0 from float noise must not leak a nan into the
+    violation term."""
+    xp = _xp(den)
+    ok = den != 0
+    ratio = xp.where(ok, num / xp.where(ok, den, 1.0), sentinel)
+    return xp.where(xp.isfinite(ratio), ratio, sentinel)

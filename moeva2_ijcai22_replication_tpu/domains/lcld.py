@@ -29,9 +29,9 @@ from . import augmentation
 N_BASE_FEATURES = 47
 
 
-def _months(date_feature: jnp.ndarray) -> jnp.ndarray:
-    """YYYYMM integer-coded date -> month count (floor(f/100)*12 + f mod 100)."""
-    return jnp.floor(date_feature / 100.0) * 12.0 + jnp.mod(date_feature, 100.0)
+# single-sourced in the IR operator library (same definition, numpy/jnp
+# dispatched); kept under the old name — domains/lcld_sat.py imports it
+from .ir.ops import months as _months
 
 
 def _installment(loan_amnt, term, int_rate):

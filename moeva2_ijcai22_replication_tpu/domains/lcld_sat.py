@@ -45,7 +45,7 @@ import numpy as np
 
 from ..core.schema import FeatureSchema
 from ..attacks.sat.engine import LinearRows
-from .lcld import _months
+from .ir.ops import months as _months
 
 SLACK = 1e-4  # inside the evaluator's 1e-3 snap tolerance
 

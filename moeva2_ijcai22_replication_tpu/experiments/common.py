@@ -286,13 +286,24 @@ def load_constraints(config: dict):
     important-features path (``04_moeva.py:43-53``). Memoized: every grid
     point naming the same CSVs shares one constraints object."""
     project = config["project_name"]
+    spec_path = config.get("spec")
     paths = [config["paths"]["features"], config["paths"]["constraints"]]
     important = config["paths"].get("important_features")
     if important:
         paths.append(important)
+    if spec_path:
+        # domain-as-data: the constraint class is compiled from the named
+        # spec file rather than looked up; the file rides in the mtime+size
+        # cache key, so editing a spec invalidates the memoized domain
+        paths.append(spec_path)
 
     def build():
-        cls = get_constraints_class(project)
+        if spec_path:
+            from ..domains.ir import compile_spec_path
+
+            cls = compile_spec_path(spec_path, name=project)
+        else:
+            cls = get_constraints_class(project)
         kwargs = (
             {"important_features_path": important} if important else {}
         )
@@ -336,7 +347,15 @@ def load_surrogate(config: dict):
 
 def get_sat_builder(project_name: str, constraints):
     """Project-name -> MILP row builder (parity:
-    ``united/utils.py:28-30``'s STR_TO_SAT_CONSTRAINTS)."""
+    ``united/utils.py:28-30``'s STR_TO_SAT_CONSTRAINTS).
+
+    Spec-compiled domains route to the IR's MILP backend — one compiler for
+    every spec — before the hand-written prefix matches, so ``lcld_spec``
+    gets its own linearization rather than the hand-written twin's."""
+    from ..domains.ir import SpecConstraintSet, make_spec_sat_builder
+
+    if isinstance(constraints, SpecConstraintSet):
+        return make_spec_sat_builder(constraints)
     if project_name.startswith("lcld"):
         return make_lcld_sat_builder(constraints.schema)
     if project_name.startswith("botnet"):

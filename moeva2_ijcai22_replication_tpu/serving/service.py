@@ -161,6 +161,30 @@ class _Resolved:
     prewarm: Callable[[np.ndarray], None] | None = None
 
 
+def _domain_origins(domains: dict) -> dict:
+    """Per served domain: ``{"origin": handwritten|spec|generated,
+    "spec_hash": ...}`` — spec-file domains hash the file content (cheap:
+    parse + canonicalize, no kernel compile), registry names resolve
+    through the domains registry."""
+    out = {}
+    for name, cfg in sorted(domains.items()):
+        try:
+            if cfg.get("spec"):
+                from ..domains.ir import load_spec, spec_hash
+
+                spec = load_spec(
+                    cfg["spec"], name=cfg.get("project_name", name)
+                )
+                out[name] = {"origin": "spec", "spec_hash": spec_hash(spec)}
+            else:
+                from ..domains import domain_origin
+
+                out[name] = domain_origin(cfg["project_name"])
+        except Exception as exc:  # mis-deployed replica: visible, not fatal
+            out[name] = {"origin": "unknown", "error": str(exc)}
+    return out
+
+
 class AttackService:
     """In-process attack server: bounded queues, microbatched execution.
 
@@ -215,7 +239,13 @@ class AttackService:
             else ServiceMetrics(window=metrics_window, recorder=self.recorder)
         )
         self.stream = stream
-        self._build = build_identity(self.domains)
+        # build identity + per-domain provenance: handwritten class, spec
+        # (with the spec's content hash — the revision two replicas must
+        # agree on to share AOT executables), or generated family
+        self._build = dict(
+            build_identity(self.domains),
+            domain_origins=_domain_origins(self.domains),
+        )
         self.clock = clock or time.monotonic
         self.menu = BucketMenu(bucket_sizes)
         # SLO substrate (observability.slo): per-(domain, stage) latency
@@ -273,6 +303,9 @@ class AttackService:
             "n_offsprings": cfg.get("n_offsprings", 8),
         }
         for k in (
+            # domain-as-data: a domain served from a spec file forwards the
+            # path so load_constraints compiles it (and keys caches on it)
+            "spec",
             "constraints_optim",
             "nb_random",
             "archive_size",

@@ -1,0 +1,616 @@
+"""Constraint-expression IR (``domains/ir/``): the domain-as-data subsystem.
+
+Covers the ISSUE-13 tentpole end to end, dataset-free (code-derived
+synthetic schemas + the committed spec package data):
+
+- parser round-trip: spec text -> AST -> canonical text -> AST is a fixed
+  point, and the spec hash is formatting-independent but semantics-
+  sensitive;
+- per-operator jnp == numpy unit semantics (arithmetic, power, guarded
+  ratios, YYYYMM date arithmetic, membership, group sums);
+- the committed ``lcld``/``botnet`` specs compile to kernels BIT-EXACT
+  against the hand-written ``lcld_constraint_terms`` /
+  ``BotnetConstraints._raw`` twins;
+- the repair backend re-derives dependent features (defining equalities
+  land at zero, memberships snap into the value set);
+- MILP-backend feasibility: SatAttack solutions built from the spec
+  linearization satisfy the spec's own jnp kernel at tolerance;
+- seeded generator determinism (same seed -> same spec hash, same bytes);
+- registry + provenance: three origins, ledger tags, /healthz
+  ``build.domain_origins``;
+- the tier-1 smoke: a spec-compiled domain runs MoEvA + PGD + serving
+  with ZERO extra compiled executables vs its hand-written twin, and the
+  oracle fixture's phishing engine rates reproduce bit-for-bit.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from moeva2_ijcai22_replication_tpu.domains import (
+    SPEC_DIR,
+    SPEC_DOMAINS,
+    domain_origin,
+    get_constraints_class,
+    spec_domain_dir,
+)
+from moeva2_ijcai22_replication_tpu.domains.ir import (
+    Env,
+    compile_spec,
+    generate_family,
+    load_spec,
+    make_spec_sat_builder,
+    months,
+    parse_constraint,
+    parse_expr,
+    safe_div,
+    sample_family,
+    spec_hash,
+    validate_spec,
+    write_family,
+)
+from moeva2_ijcai22_replication_tpu.domains.ir.expr import (
+    canon_constraint,
+    canon_expr,
+    eval_expr,
+    eval_term,
+)
+from moeva2_ijcai22_replication_tpu.domains.ir.ops import finite_div
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints, _months
+from moeva2_ijcai22_replication_tpu.domains.synth import (
+    synth_botnet,
+    synth_botnet_schema,
+    synth_lcld,
+    synth_lcld_schema,
+    synth_phishing,
+)
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# shared problems (module-scoped: schemas + compiled kernels are reused)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lcld_pair(tmp_path_factory):
+    """(hand-written, spec-compiled) constraint sets on one synthetic
+    schema, plus manifold + perturbed sample batches."""
+    tmp = tmp_path_factory.mktemp("ir_lcld")
+    paths = synth_lcld_schema(str(tmp))
+    hand = LcldConstraints(paths["features"], paths["constraints"])
+    cls = get_constraints_class("lcld_spec")
+    spec_cons = cls(paths["features"], paths["constraints"])
+    x = synth_lcld(48, hand.schema, seed=5)
+    rng = np.random.default_rng(6)
+    x_pert = x * (1.0 + 0.05 * rng.standard_normal(x.shape))
+    return hand, spec_cons, x, x_pert, paths
+
+
+@pytest.fixture(scope="module")
+def botnet_pair(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ir_botnet")
+    paths = synth_botnet_schema(str(tmp))
+    from moeva2_ijcai22_replication_tpu.domains.botnet import BotnetConstraints
+
+    hand = BotnetConstraints(paths["features"], paths["constraints"])
+    cls = get_constraints_class("botnet_spec")
+    spec_cons = cls(paths["features"], paths["constraints"])
+    x = synth_botnet(32, hand.schema, seed=5)
+    rng = np.random.default_rng(6)
+    x_pert = x * (1.0 + 0.05 * rng.standard_normal(x.shape))
+    return hand, spec_cons, x, x_pert
+
+
+@pytest.fixture(scope="module")
+def phishing_cons():
+    d = spec_domain_dir("phishing")
+    return get_constraints_class("phishing")(
+        os.path.join(d, "features.csv"), os.path.join(d, "constraints.csv")
+    )
+
+
+# ---------------------------------------------------------------------------
+# parser + hashing
+# ---------------------------------------------------------------------------
+
+
+class TestParser:
+    def test_round_trip_committed_specs(self):
+        """spec -> AST -> canonical text -> AST is a fixed point for every
+        committed spec (names, kinds, canonical forms all survive)."""
+        for name, rel in SPEC_DOMAINS.items():
+            spec = load_spec(os.path.join(SPEC_DIR, rel), name=name)
+            assert spec.constraints, name
+            for c in spec.constraints:
+                text = canon_constraint(c)
+                c2 = parse_constraint(c.name, text)
+                assert c2.kind == c.kind, (name, c.name)
+                assert canon_constraint(c2) == text, (name, c.name)
+
+    def test_precedence_and_associativity(self):
+        assert canon_expr(parse_expr("a + b * c")) == canon_expr(
+            parse_expr("a + (b * c)")
+        )
+        assert canon_expr(parse_expr("(a + b) * c")) != canon_expr(
+            parse_expr("a + b * c")
+        )
+        # ^ binds tighter than unary minus and is right-associative
+        assert canon_expr(parse_expr("a ^ b ^ c")) == canon_expr(
+            parse_expr("a ^ (b ^ c)")
+        )
+        assert canon_expr(parse_expr("-a ^ 2.0")) == canon_expr(
+            parse_expr("-(a ^ 2.0)")
+        )
+
+    def test_hash_formatting_independent_semantics_sensitive(self):
+        from moeva2_ijcai22_replication_tpu.domains.ir import ConstraintSpec
+
+        def mk(text):
+            return spec_hash(
+                ConstraintSpec(
+                    name="t", constraints=(parse_constraint("c", text),)
+                )
+            )
+
+        assert mk("x + y*z <= 3.0") == mk("x   +  (y * z) <= 3.0")
+        assert mk("x + y*z <= 3.0") != mk("x + y*z <= 4.0")
+
+    def test_committed_spec_hashes_are_stable_objects(self):
+        """Loading the same committed file twice yields the same hash;
+        the three committed domains have three distinct hashes."""
+        hashes = {}
+        for name, rel in SPEC_DOMAINS.items():
+            p = os.path.join(SPEC_DIR, rel)
+            assert spec_hash(load_spec(p, name=name)) == spec_hash(
+                load_spec(p, name=name)
+            )
+            hashes[name] = spec_hash(load_spec(p, name=name))
+        assert len(set(hashes.values())) == len(hashes)
+
+
+# ---------------------------------------------------------------------------
+# per-operator unit semantics: jnp == numpy
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorSemantics:
+    ENV = Env(
+        {"a": 0, "b": 1, "d": 2},
+        {"g": np.array([0, 1, 2])},
+    )
+
+    X = np.array(
+        [[2.0, 3.0, 4.0], [0.5, -1.0, 0.0], [200105.0, 199812.0, 1.0]]
+    )
+
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "a + b",
+            "a - b",
+            "a * b",
+            "b / a",
+            "a ^ 2.0",
+            "abs(a - b)",
+            "-a + b",
+            "months(a) - months(b)",
+            "safe_div(a, d, -7.0)",
+            "finite_div(b, d, -7.0)",
+            "sum(@g)",
+            "sum(@g) / a",
+            "@g - a",
+        ],
+    )
+    def test_jnp_equals_numpy(self, text):
+        node = parse_expr(text)
+        v_np, w_np = eval_expr(node, self.X, self.ENV, np)
+        v_j, w_j = eval_expr(node, jnp.asarray(self.X), self.ENV, jnp)
+        assert w_np == w_j
+        np.testing.assert_allclose(
+            np.asarray(v_j, np.float64), np.asarray(v_np, np.float64),
+            rtol=0, atol=0,
+        )
+
+    @pytest.mark.parametrize(
+        "text,kind",
+        [
+            ("a <= b", "le"),
+            ("a == b * d", "eq"),
+            ("a in {0.5, 2.0}", "member"),
+        ],
+    )
+    def test_term_semantics(self, text, kind):
+        c = parse_constraint("t", text)
+        assert c.kind == kind
+        v_np, _ = eval_term(c, self.X, self.ENV, np)
+        v_j, _ = eval_term(c, jnp.asarray(self.X), self.ENV, jnp)
+        np.testing.assert_array_equal(
+            np.asarray(v_j, np.float64), np.asarray(v_np, np.float64)
+        )
+
+    def test_guarded_ratio_ops(self):
+        # zero denominator -> sentinel, no inf/nan escapes
+        assert float(safe_div(np.float64(3.0), np.float64(0.0), -7.0)) == -7.0
+        assert float(
+            finite_div(np.float64(3.0), np.float64(0.0), -7.0)
+        ) == -7.0
+        assert float(safe_div(np.float64(3.0), np.float64(2.0), -7.0)) == 1.5
+        j = safe_div(jnp.asarray(3.0), jnp.asarray(0.0), -7.0)
+        assert float(j) == -7.0
+
+    def test_months_single_source(self):
+        """One tested definition used by lcld (jnp) and synth (numpy)."""
+        f = np.array([200105.0, 199812.0, 202012.0])
+        want = np.floor(f / 100.0) * 12.0 + np.mod(f, 100.0)
+        np.testing.assert_array_equal(months(f), want)
+        np.testing.assert_array_equal(
+            np.asarray(months(jnp.asarray(f)), np.float64), want
+        )
+        # domains.lcld imports THE op (no second copy to drift)
+        assert _months is months
+
+
+# ---------------------------------------------------------------------------
+# compiled-vs-handwritten equivalence (the tentpole's proof obligation)
+# ---------------------------------------------------------------------------
+
+
+class TestEquivalence:
+    def test_lcld_bit_exact(self, lcld_pair):
+        hand, spec_cons, x, x_pert, _ = lcld_pair
+        assert spec_cons.n_constraints == hand.n_constraints
+        for xx in (x, x_pert):
+            a = np.asarray(spec_cons._raw(jnp.asarray(xx)))
+            b = np.asarray(hand._raw(jnp.asarray(xx)))
+            np.testing.assert_array_equal(a, b)
+
+    def test_botnet_bit_exact(self, botnet_pair):
+        hand, spec_cons, x, x_pert = botnet_pair
+        assert spec_cons.n_constraints == hand.n_constraints == 360
+        for xx in (x, x_pert):
+            a = np.asarray(spec_cons._raw(jnp.asarray(xx)))
+            b = np.asarray(hand._raw(jnp.asarray(xx)))
+            np.testing.assert_array_equal(a, b)
+
+    def test_numpy_twin_agrees(self, lcld_pair):
+        """The spec's numpy oracle twin tracks the jnp kernel (f64)."""
+        _, spec_cons, x, x_pert, _ = lcld_pair
+        for xx in (x, x_pert):
+            a = np.asarray(spec_cons._raw(jnp.asarray(xx)), np.float64)
+            b = spec_cons.raw_numpy(xx)
+            np.testing.assert_allclose(a, b, rtol=0, atol=1e-9)
+
+    def test_lcld_repair_matches_handwritten(self, lcld_pair):
+        """The derived repair agrees bit-exactly with the hand-written one
+        on every column the hand-written projection touches (term snap,
+        installment formula, one-hot hardening); on the rest it is a
+        strict superset — it also re-derives the remaining defining
+        equalities (the ratio features the hand-written repair leaves
+        stale), so its total residual is never worse."""
+        hand, spec_cons, _, x_pert, _ = lcld_pair
+        a = np.asarray(spec_cons.repair(jnp.asarray(x_pert)))
+        b = np.asarray(hand.repair(jnp.asarray(x_pert)))
+        touched = {1, 3}
+        for grp, mask in zip(np.asarray(hand._ohe_idx), np.asarray(hand._ohe_mask)):
+            touched |= set(int(c) for c in grp[mask])
+        cols = sorted(touched)
+        np.testing.assert_array_equal(a[:, cols], b[:, cols])
+        ga = np.asarray(spec_cons.evaluate(jnp.asarray(a))).sum()
+        gb = np.asarray(hand.evaluate(jnp.asarray(b))).sum()
+        assert ga <= gb + 1e-9
+
+    def test_repair_re_derives_dependents(self, phishing_cons):
+        """Defining equalities land at ~0 and memberships snap after the
+        compiled repair projection on off-manifold rows."""
+        x = synth_phishing(24, phishing_cons.schema, seed=9)
+        rng = np.random.default_rng(10)
+        x_bad = x * (1.0 + 0.2 * rng.standard_normal(x.shape))
+        fixed = np.asarray(phishing_cons.repair(jnp.asarray(x_bad)))
+        res = phishing_cons.resolved
+        raw = phishing_cons.raw_numpy(fixed)
+        col = 0
+        for c, w in zip(res.spec.constraints, res.widths):
+            if c.kind in ("eq", "member"):
+                assert float(np.abs(raw[:, col : col + w]).max()) < 1e-6, c.name
+            col += w
+        # https snapped into {0, 1}
+        hcol = phishing_cons.resolved.env.col("https")
+        assert set(np.unique(fixed[:, hcol])) <= {0.0, 1.0}
+
+
+# ---------------------------------------------------------------------------
+# MILP backend feasibility
+# ---------------------------------------------------------------------------
+
+
+class TestMilpBackend:
+    @pytest.mark.parametrize("domain", ["phishing", "lcld_spec"])
+    def test_sat_solutions_satisfy_kernel(self, domain, lcld_pair, phishing_cons):
+        """End-to-end: SatAttack over the spec linearization; every
+        returned candidate satisfies the spec's own jnp kernel at the
+        evaluator tolerance."""
+        from moeva2_ijcai22_replication_tpu.attacks.sat import SatAttack
+
+        if domain == "phishing":
+            cons = phishing_cons
+            x = synth_phishing(4, cons.schema, seed=11)
+        else:
+            _, cons, x_all, _, _ = lcld_pair
+            x = x_all[:4]
+        xl, xu = cons.get_feature_min_max(dynamic_input=x)
+        xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+        xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+        scaler = fit_minmax(
+            np.minimum(x.min(0), xl.min(0)), np.maximum(x.max(0), xu.max(0))
+        )
+        attack = SatAttack(
+            constraints=cons,
+            sat_rows_builder=make_spec_sat_builder(cons),
+            min_max_scaler=scaler,
+            eps=0.5,
+            norm=np.inf,
+            n_sample=4,
+        )
+        out = attack.generate(x)
+        assert out.shape[0] == x.shape[0]
+        g = np.asarray(cons.evaluate(jnp.asarray(out.reshape(-1, x.shape[-1]))))
+        assert float(np.nanmax(g)) <= 0.05
+
+    def test_builder_shapes(self, phishing_cons):
+        b = make_spec_sat_builder(phishing_cons)
+        x = synth_phishing(1, phishing_cons.schema, seed=2)[0]
+        rows = b(x, x)
+        assert rows.feasible
+        assert rows.rows  # affine rows emitted
+        assert rows.n_extra_bin >= 1  # https membership mode binary
+
+
+# ---------------------------------------------------------------------------
+# generator
+# ---------------------------------------------------------------------------
+
+
+class TestGenerator:
+    def test_deterministic_same_seed(self, tmp_path):
+        _, _, spec_a, _ = generate_family(13)
+        _, _, spec_b, _ = generate_family(13)
+        assert spec_hash(spec_a) == spec_hash(spec_b)
+        xa, _, _ = sample_family(32, seed=13)
+        xb, _, _ = sample_family(32, seed=13)
+        np.testing.assert_array_equal(xa, xb)
+        da = write_family(str(tmp_path / "a"), 13)
+        db = write_family(str(tmp_path / "b"), 13)
+        for fn in ("features.csv", "constraints.csv"):
+            pa, pb = os.path.join(da, fn), os.path.join(db, fn)
+            with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                assert fa.read() == fb.read(), fn
+
+    def test_distinct_seeds_distinct_specs(self):
+        _, _, a, _ = generate_family(1)
+        _, _, b, _ = generate_family(2)
+        assert spec_hash(a) != spec_hash(b)
+
+    def test_samples_satisfy_compiled_kernel(self, tmp_path):
+        x, schema, spec = sample_family(32, seed=21)
+        out = write_family(str(tmp_path), 21)
+        cons = compile_spec(spec)(os.path.join(out, "features.csv"), None)
+        g = np.asarray(cons.evaluate(jnp.asarray(x)))
+        assert float(np.nanmax(g)) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry + provenance
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_handwritten_names_unchanged(self):
+        assert get_constraints_class("lcld") is LcldConstraints
+
+    def test_origins(self):
+        assert domain_origin("lcld") == {
+            "origin": "handwritten",
+            "spec_hash": None,
+        }
+        o = domain_origin("lcld_spec")
+        assert o["origin"] == "spec" and len(o["spec_hash"]) == 64
+        g = domain_origin("family3")
+        assert g["origin"] == "generated" and g["spec_hash"]
+
+    def test_unknown_project_raises(self):
+        with pytest.raises(ValueError, match="family<seed>"):
+            get_constraints_class("nope")
+
+    def test_ledger_tags(self, lcld_pair):
+        hand, spec_cons, _, _, _ = lcld_pair
+        # hand-written tags are byte-identical to the pre-IR ledger keys
+        assert hand.ledger_tag == "LcldConstraints"
+        assert spec_cons.ledger_tag.startswith("spec:lcld_spec:")
+        assert spec_cons.ledger_tag.split(":")[2] == spec_cons.resolved.hash[:12]
+
+    def test_committed_specs_validate(self, lcld_pair, botnet_pair, phishing_cons):
+        """No fatal static findings on any committed spec (the lcld
+        non-guarded-denominator warnings are reference-faithful)."""
+        hand, spec_cons, _, _, _ = lcld_pair
+        findings = validate_spec(spec_cons.spec, hand.schema)
+        assert all("non-guarded denominator" in f for f in findings)
+        bh, bs, _, _ = botnet_pair
+        assert validate_spec(bs.spec, bh.schema) == []
+        assert validate_spec(
+            phishing_cons.spec, phishing_cons.schema
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: engines + serving with zero extra compiles, fixture repro
+# ---------------------------------------------------------------------------
+
+
+class TestTier1Smoke:
+    def test_moeva_pgd_zero_extra_compiles(self, lcld_pair):
+        """The spec twin runs MoEvA + PGD compiling EXACTLY as many
+        executables as the hand-written domain at the same shapes (and
+        produces bit-identical candidates: the kernels, repair, and
+        engine identities all line up)."""
+        from moeva2_ijcai22_replication_tpu.attacks.moeva import Moeva2
+        from moeva2_ijcai22_replication_tpu.attacks.pgd import ConstrainedPGD
+        from moeva2_ijcai22_replication_tpu.observability.ledger import (
+            get_ledger,
+        )
+
+        hand, spec_cons, x, _, _ = lcld_pair
+        x = x[:8]
+        model = lcld_mlp()
+        sur = Surrogate(model, init_params(model, hand.schema.n_features, seed=1))
+        scaler = fit_minmax(x.min(0), x.max(0))
+        ledger = get_ledger()
+
+        def run(cons):
+            before = {e.key for e in ledger.entries()}
+            moeva = Moeva2(
+                classifier=sur, constraints=cons, ml_scaler=scaler,
+                norm=2, n_gen=4, n_pop=8, n_offsprings=4, seed=0,
+            )
+            res = moeva.generate(x, minimize_class=1)
+            pgd = ConstrainedPGD(
+                classifier=sur, constraints=cons, scaler=scaler,
+                eps=0.2, eps_step=0.05, max_iter=3,
+                loss_evaluation="constraints+flip",
+            )
+            xs = np.asarray(scaler.transform(x))
+            adv = pgd.generate(xs, np.ones(len(xs), dtype=np.int64))
+            new = [e for e in ledger.entries() if e.key not in before]
+            return np.asarray(res.x_ml), np.asarray(adv), len(new)
+
+        x_hand, adv_hand, n_hand = run(hand)
+        x_spec, adv_spec, n_spec = run(spec_cons)
+        assert n_spec == n_hand, (
+            f"spec domain compiled {n_spec} executables vs the hand-written "
+            f"twin's {n_hand} at identical shapes"
+        )
+        np.testing.assert_array_equal(x_spec, x_hand)
+        np.testing.assert_array_equal(adv_spec, adv_hand)
+
+    def test_serving_spec_domain_and_origins(self, lcld_pair, tmp_path):
+        """One service, two tenants (hand-written lcld + spec twin served
+        through the config ``spec:`` path): both serve the same rows, the
+        spec tenant compiles no extra executables for the same bucket, and
+        /healthz ``build.domain_origins`` carries the provenance."""
+        import joblib
+        from sklearn.preprocessing import MinMaxScaler as SkMinMax
+
+        from moeva2_ijcai22_replication_tpu.models.io import save_params
+        from moeva2_ijcai22_replication_tpu.observability.ledger import (
+            get_ledger,
+        )
+        from moeva2_ijcai22_replication_tpu.serving import (
+            AttackRequest,
+            AttackService,
+        )
+
+        hand, _, x, _, paths = lcld_pair
+        model = lcld_mlp()
+        sur = Surrogate(model, init_params(model, hand.schema.n_features, seed=1))
+        model_path = str(tmp_path / "nn.msgpack")
+        save_params(sur, model_path)
+        xl, xu = hand.get_feature_min_max(dynamic_input=x)
+        xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+        xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+        scaler_path = str(tmp_path / "scaler.joblib")
+        joblib.dump(SkMinMax().fit(np.vstack([x, xl, xu])), scaler_path)
+        base = {
+            "norm": 2,
+            "paths": {
+                "model": model_path,
+                "features": paths["features"],
+                "constraints": paths["constraints"],
+                "ml_scaler": scaler_path,
+            },
+            "system": {"mesh_devices": 0},
+        }
+        domains = {
+            "lcld": dict(base, project_name="lcld"),
+            "lcld_spec": dict(
+                base,
+                project_name="lcld_spec",
+                spec=os.path.join(SPEC_DIR, SPEC_DOMAINS["lcld_spec"]),
+            ),
+        }
+        service = AttackService(domains, bucket_sizes=(8,), max_delay_s=0.002)
+        try:
+            origins = service.healthz()["build"]["domain_origins"]
+            assert origins["lcld"]["origin"] == "handwritten"
+            assert origins["lcld_spec"]["origin"] == "spec"
+            assert len(origins["lcld_spec"]["spec_hash"]) == 64
+            ledger = get_ledger()
+            r1 = service.attack(
+                AttackRequest(domain="lcld", x=x[:4], eps=0.2, budget=3),
+                timeout=300.0,
+            )
+            before = {e.key for e in ledger.entries()}
+            r2 = service.attack(
+                AttackRequest(domain="lcld_spec", x=x[:4], eps=0.2, budget=3),
+                timeout=300.0,
+            )
+            new = [e for e in ledger.entries() if e.key not in before]
+            n_hand_like = len(
+                [e for e in ledger.entries() if e.key in before]
+            )
+            assert r1.x_adv.shape == r2.x_adv.shape == x[:4].shape
+            # the spec tenant's request path compiles the same program
+            # count the hand-written tenant needed for this bucket — no
+            # spec-compilation overhead leaks into serving
+            assert len(new) <= max(1, n_hand_like)
+            np.testing.assert_array_equal(r2.x_adv, r1.x_adv)
+        finally:
+            service.close()
+
+    def test_phishing_fixture_rates_reproduce(self):
+        """Quick tier: the committed oracle-fixture budget-100 phishing
+        rates (the new data-only domain) reproduce bit-for-bit at seed 42
+        — same discipline as lcld_synth."""
+        oc = _load_tool("oracle_check")
+        with open(os.path.join(FIXTURES, "oracle_interior_rates.json")) as fh:
+            fixture = json.load(fh)
+        d = fixture["domains"]["phishing"]
+        assert d["config"] == oc.DOMAINS["phishing"], (
+            "fixture config drifted from tools/oracle_check.py — rerun "
+            "--regen and commit"
+        )
+        problem = oc.build_phishing(oc.DOMAINS["phishing"])
+        rates = oc.engine_rates(problem, oc.DOMAINS["phishing"], 42)
+        np.testing.assert_allclose(rates, d["engine"]["42"], atol=0)
+
+    @pytest.mark.slow
+    def test_phishing_oracle_ga_cross_check(self):
+        """Slow tier: the f64 oracle-GA replay for the data-only domain
+        — zero survival mismatches, committed rates reproduce."""
+        oc = _load_tool("oracle_check")
+        with open(os.path.join(FIXTURES, "oracle_interior_rates.json")) as fh:
+            fixture = json.load(fh)
+        cfg = oc.DOMAINS["phishing"]
+        problem = oc.build_phishing(cfg)
+        out = oc.oracle_ga_rates(problem, cfg, 42, check_states=np.arange(4))
+        want = fixture["domains"]["phishing"]["oracle_ga"]["42"]
+        np.testing.assert_allclose(out["o_rates"], want["o_rates"], atol=0)
+        assert out["mismatches"] == []

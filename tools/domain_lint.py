@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Domain/spec lint gate: validate the committed constraint specs.
+
+The constraint-IR subsystem (``domains/ir/``) makes domains data; this
+gate makes bad data unmergeable. For every committed spec domain
+(``domains/__init__.py::SPEC_DOMAINS``) it:
+
+- **parses + statically validates** the spec (``ir.spec.validate_spec``)
+  against the domain's schema. Fatal findings: undefined features,
+  duplicate constraint names, membership values outside feature bounds.
+  "Non-guarded denominator" findings are WARNINGS, not errors — the
+  reference's own hand-written lcld kernel divides unguarded in
+  g6/g8/g9, and the committed spec documents, not rewrites, the
+  reference semantics.
+- **checks OHE group coverage** — the schema's one-hot groups must build
+  (``core.codec.full_ohe_tables``) so the compiled repair's
+  ``harden_onehot`` finale covers every group.
+- **recompiles the jnp backend and replays the equivalence fixtures**:
+  ``lcld_spec`` vs the hand-written ``lcld_constraint_terms`` and
+  ``botnet_spec`` vs ``BotnetConstraints._raw`` must agree BIT-EXACTLY
+  on seeded samples (manifold + perturbed); every spec's jnp kernel must
+  agree with its own numpy oracle twin at float64 tolerance.
+- **compiles the MILP backend** (``ir.milp_backend.make_spec_sat_builder``)
+  and builds rows at a sampled hot start — a spec the SAT/repair path
+  cannot linearize fails the gate before it fails an attack run.
+- **smokes the generated-family path**: ``family0`` compiles and its
+  seeded sampler is deterministic (same seed → same bytes).
+
+Dataset-free by construction: lcld/botnet validate against the
+code-derived synthetic schemas (``domains/synth.py``) unless the
+reference tree exists, in which case botnet also validates against the
+real 756-feature schema + ``feat_idx.pickle``; phishing validates
+against its committed package data. Same skip-vs-fail convention as
+tools/oracle_check.py / tools/shard_lint.py.
+
+    python tools/domain_lint.py --check        # tier-1 repo-check mode
+    python tools/domain_lint.py --check --json # + machine-readable line
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+REFERENCE = "/root/reference"
+
+#: validate_spec finding substrings that are advisory, not fatal (the
+#: committed lcld spec reproduces the reference kernel's unguarded
+#: ratios on purpose — see module docstring)
+WARNING_MARKERS = ("non-guarded denominator",)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _split_findings(findings: list) -> tuple[list, list]:
+    warnings, errors = [], []
+    for f in findings:
+        (warnings if any(m in f for m in WARNING_MARKERS) else errors).append(f)
+    return errors, warnings
+
+
+def _domain_artifacts(name: str, tmp: str):
+    """(features_csv, constraints_csv, sampler) for one committed spec
+    domain — reference artifacts when present, synthetic otherwise."""
+    from moeva2_ijcai22_replication_tpu.domains import spec_domain_dir
+    from moeva2_ijcai22_replication_tpu.domains.synth import (
+        synth_botnet,
+        synth_botnet_schema,
+        synth_lcld,
+        synth_lcld_schema,
+        synth_phishing,
+    )
+
+    if name == "lcld_spec":
+        paths = synth_lcld_schema(os.path.join(tmp, "lcld"))
+        return paths["features"], paths["constraints"], synth_lcld
+    if name == "botnet_spec":
+        ref = os.path.join(REFERENCE, "data", "botnet")
+        if os.path.exists(os.path.join(ref, "feat_idx.pickle")):
+            return (
+                os.path.join(ref, "features.csv"),
+                os.path.join(ref, "constraints.csv"),
+                synth_botnet,
+            )
+        paths = synth_botnet_schema(os.path.join(tmp, "botnet"))
+        return paths["features"], paths["constraints"], synth_botnet
+    if name == "phishing":
+        d = spec_domain_dir("phishing")
+        return (
+            os.path.join(d, "features.csv"),
+            os.path.join(d, "constraints.csv"),
+            synth_phishing,
+        )
+    raise KeyError(name)
+
+
+def lint_spec_domain(name: str, tmp: str) -> dict:
+    """All checks for one committed spec domain; returns
+    ``{errors, warnings, checks}``."""
+    from moeva2_ijcai22_replication_tpu.core.codec import full_ohe_tables
+    from moeva2_ijcai22_replication_tpu.core.schema import FeatureSchema
+    from moeva2_ijcai22_replication_tpu.domains import (
+        SPEC_DIR,
+        SPEC_DOMAINS,
+        get_constraints_class,
+    )
+    from moeva2_ijcai22_replication_tpu.domains.ir import (
+        load_spec,
+        make_spec_sat_builder,
+        validate_spec,
+    )
+
+    errors: list[str] = []
+    warnings: list[str] = []
+    checks: list[str] = []
+    spec_path = os.path.join(SPEC_DIR, SPEC_DOMAINS[name])
+    features_csv, constraints_csv, sampler = _domain_artifacts(name, tmp)
+
+    # 1. parse + static validation against the schema
+    spec = load_spec(spec_path, name=name)
+    schema = FeatureSchema.from_csv(features_csv)
+    errs, warns = _split_findings(validate_spec(spec, schema))
+    errors += [f"validate: {e}" for e in errs]
+    warnings += [f"validate: {w}" for w in warns]
+    checks.append("validate_spec")
+
+    # 2. OHE group coverage must build for the repair finale
+    try:
+        full_ohe_tables(schema)
+        checks.append("ohe_tables")
+    except Exception as e:
+        errors.append(f"ohe_tables: {type(e).__name__}: {e}")
+
+    # 3. jnp backend compiles + numpy-twin agreement on seeded samples
+    try:
+        cons = get_constraints_class(name)(features_csv, constraints_csv)
+        x = sampler(32, cons.schema, seed=5)
+        rng = np.random.default_rng(6)
+        x_pert = x * (1.0 + 0.05 * rng.standard_normal(x.shape))
+        for label, xx in (("manifold", x), ("perturbed", x_pert)):
+            got = np.asarray(cons._raw(np.asarray(xx)))
+            want = cons.raw_numpy(xx)
+            delta = float(np.nanmax(np.abs(got - want)))
+            if not (delta < 1e-8 or np.isnan(delta)):
+                errors.append(
+                    f"np_twin[{label}]: jnp kernel vs numpy oracle "
+                    f"max|Δ|={delta:.3e}"
+                )
+        checks.append("np_twin")
+    except Exception as e:
+        errors.append(f"jnp_backend: {type(e).__name__}: {e}")
+        return {"errors": errors, "warnings": warnings, "checks": checks}
+
+    # 4. hand-written equivalence fixtures (bit-exact) for the twins
+    twin = {"lcld_spec": "lcld", "botnet_spec": "botnet"}.get(name)
+    if twin is not None:
+        hand = get_constraints_class(twin)(features_csv, constraints_csv)
+        for label, xx in (("manifold", x), ("perturbed", x_pert)):
+            a = np.asarray(cons._raw(np.asarray(xx)))
+            b = np.asarray(hand._raw(np.asarray(xx)))
+            exact = bool(
+                np.array_equal(a, b) or np.array_equal(
+                    np.nan_to_num(a, nan=-1.0), np.nan_to_num(b, nan=-1.0)
+                )
+            )
+            if not exact:
+                errors.append(
+                    f"equivalence[{label}]: compiled {name} != "
+                    f"hand-written {twin} (max|Δ|="
+                    f"{float(np.nanmax(np.abs(a - b))):.3e})"
+                )
+        checks.append(f"equivalence_vs_{twin}")
+
+    # 5. MILP backend compiles and builds rows at a sampled hot start
+    try:
+        builder = make_spec_sat_builder(cons)
+        out = builder(np.asarray(x[0], float), np.asarray(x[0], float))
+        n_rows = len(out.rows) + len(out.fixes)
+        if out.feasible and n_rows == 0:
+            errors.append("milp: builder returned feasible but EMPTY rows")
+        checks.append("milp_build")
+    except Exception as e:
+        errors.append(f"milp: {type(e).__name__}: {e}")
+
+    return {"errors": errors, "warnings": warnings, "checks": checks}
+
+
+def lint_generated_family(seed: int = 0) -> dict:
+    """family<seed> compiles; seeded sampling is byte-deterministic."""
+    from moeva2_ijcai22_replication_tpu.domains import (
+        domain_origin,
+        get_constraints_class,
+    )
+    from moeva2_ijcai22_replication_tpu.domains.ir import sample_family
+
+    errors: list[str] = []
+    name = f"family{seed}"
+    cls = get_constraints_class(name)
+    origin = domain_origin(name)
+    if origin["origin"] != "generated" or not origin["spec_hash"]:
+        errors.append(f"{name}: origin record {origin} is not a generated spec")
+    xa, _, spec_a = sample_family(16, seed=seed)
+    xb, _, spec_b = sample_family(16, seed=seed)
+    if not np.array_equal(xa, xb):
+        errors.append(f"{name}: seeded sampler is not deterministic")
+    from moeva2_ijcai22_replication_tpu.domains.ir import spec_hash
+
+    if spec_hash(spec_a) != spec_hash(spec_b):
+        errors.append(f"{name}: seeded generator spec hash is not stable")
+    del cls
+    return {"errors": errors, "warnings": [], "checks": ["generated_family"]}
+
+
+def run_lint() -> tuple[dict, int]:
+    from moeva2_ijcai22_replication_tpu.domains import SPEC_DOMAINS
+
+    result: dict = {"domains": {}, "ok": True}
+    rc = 0
+    with tempfile.TemporaryDirectory(prefix="domain_lint_") as tmp:
+        for name in sorted(SPEC_DOMAINS):
+            res = lint_spec_domain(name, tmp)
+            result["domains"][name] = res
+            status = "FAILED" if res["errors"] else "ok"
+            print(
+                f"domain_lint: {name}: {status} "
+                f"({len(res['checks'])} checks, "
+                f"{len(res['warnings'])} warning(s))"
+            )
+            for w in res["warnings"]:
+                print(f"  warning [{name}] {w}")
+            for e in res["errors"]:
+                print(f"  ERROR [{name}] {e}")
+            if res["errors"]:
+                rc = 1
+    fam = lint_generated_family(0)
+    result["domains"]["family0"] = fam
+    print(f"domain_lint: family0: {'FAILED' if fam['errors'] else 'ok'}")
+    for e in fam["errors"]:
+        print(f"  ERROR [family0] {e}")
+    if fam["errors"]:
+        rc = 1
+    result["ok"] = rc == 0
+    print(
+        "domain_lint: "
+        + ("ok — every committed spec parses, matches its twin, and "
+           "linearizes" if rc == 0 else "FAILED")
+    )
+    return result, rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="lint the committed spec domains (tier-1 repo-check mode)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable last line"
+    )
+    args = parser.parse_args(argv)
+    if not args.check:
+        parser.error("pass --check")
+    result, rc = run_lint()
+    if args.json:
+        print(json.dumps(result))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

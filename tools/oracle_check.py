@@ -106,6 +106,26 @@ DOMAINS = {
         # interiority assertion at this n
         "interior_columns": [],
     },
+    "phishing": {
+        # the spec-compiled data-only domain (domains/specs/phishing):
+        # no hand-written module anywhere in this trajectory — schema +
+        # constraints from committed package data, candidates from the
+        # constraint-first synthetic sampler. Same dataset-free recipe
+        # as lcld_synth, certifying the IR's jnp/repair backends under
+        # the full oracle-GA replay.
+        "n_states": 16,
+        "n_gen": 100,
+        "n_pop": 40,
+        "n_offsprings": 20,
+        "archive_size": 0,
+        "norm": 2,
+        "seeds": [42, 43, 44],
+        "thresholds": {"f1": 0.5, "f2": 0.5},
+        "pool": 512,
+        "pool_seed": 11,
+        "oracle": True,
+        "interior_columns": [1, 3],
+    },
 }
 
 #: |engine mean - oracle-GA mean| bound per tracked column. The two runs
@@ -164,6 +184,45 @@ def build_lcld_synth(cfg: dict):
             "x": pool[sel]}
 
 
+def build_phishing(cfg: dict):
+    """Dataset-free spec domain: constraints compiled from the committed
+    ``domains/specs/phishing`` package data, candidates from the
+    constraint-first sampler — the same interior-mix selection recipe as
+    :func:`build_lcld_synth`."""
+    from moeva2_ijcai22_replication_tpu.domains import (
+        get_constraints_class,
+        spec_domain_dir,
+    )
+    from moeva2_ijcai22_replication_tpu.domains.synth import synth_phishing
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    d = spec_domain_dir("phishing")
+    cons = get_constraints_class("phishing")(
+        os.path.join(d, "features.csv"), os.path.join(d, "constraints.csv")
+    )
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+    pool = synth_phishing(cfg["pool"], cons.schema, seed=cfg["pool_seed"])
+    xl_d, xu_d = cons.get_feature_min_max(dynamic_input=pool)
+    lo = np.minimum(
+        pool.min(0),
+        np.broadcast_to(np.asarray(xl_d, float), pool.shape).min(0),
+    )
+    hi = np.maximum(
+        pool.max(0),
+        np.broadcast_to(np.asarray(xu_d, float), pool.shape).max(0),
+    )
+    scaler = fit_minmax(lo, hi)
+    p1 = np.asarray(sur.predict_proba(scaler.transform(pool)))[:, 1]
+    cand = np.where(p1 >= cfg["thresholds"]["f1"])[0]
+    cand = cand[np.argsort(-p1[cand])]
+    sel = cand[np.linspace(0, len(cand) - 1, cfg["n_states"]).astype(int)]
+    return {"constraints": cons, "surrogate": sur, "scaler": scaler,
+            "x": pool[sel]}
+
+
 def build_botnet(cfg: dict):
     """Real reference artifacts (None when the reference tree is absent —
     callers skip, never fake, these domains)."""
@@ -187,6 +246,8 @@ def build_botnet(cfg: dict):
 def build_problem(name: str, cfg: dict):
     if name == "lcld_synth":
         return build_lcld_synth(cfg)
+    if name == "phishing":
+        return build_phishing(cfg)
     return build_botnet(cfg)
 
 
@@ -372,6 +433,17 @@ def main(argv=None) -> int:
             results[name] = res
 
     if args.regen:
+        # merge-regen: a subset --regen (e.g. --domains phishing) must
+        # refresh ONLY the recomputed domains — silently dropping the
+        # other domains' committed records would un-pin them
+        merged = dict(results)
+        try:
+            with open(FIXTURE_PATH) as fh:
+                existing = (json.load(fh).get("domains") or {})
+        except OSError:
+            existing = {}
+        for name, rec in existing.items():
+            merged.setdefault(name, rec)
         doc = {
             "generated_by": "tools/oracle_check.py --regen (CPU x64 test platform)",
             "note": (
@@ -385,7 +457,7 @@ def main(argv=None) -> int:
                 "python tools/oracle_check.py --regen  (then commit)."
             ),
             "parity_tolerance": PARITY_TOLERANCE,
-            "domains": results,
+            "domains": merged,
         }
         os.makedirs(os.path.dirname(FIXTURE_PATH), exist_ok=True)
         with open(FIXTURE_PATH, "w") as fh:

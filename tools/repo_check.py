@@ -30,6 +30,12 @@ pollute another gate's process state):
   collective payloads, implicit host↔device transfers, or unintended
   full replication. ``--selftest`` additionally proves the lint still
   trips on injected violations.
+- ``domain_lint`` — the constraint-spec contract (``tools/domain_lint.py
+  --check``): every committed spec under ``domains/specs/`` parses,
+  statically validates against its schema, reproduces its hand-written
+  twin bit-exactly where one exists, matches its numpy oracle twin, and
+  compiles through the MILP backend; the generated-family path stays
+  deterministic.
 
 Exit code: 0 iff every selected gate passed. The summary prints one line
 per gate; ``--json`` appends ``{"ok", "gates": {name: {"rc", "ok"}}}``
@@ -57,6 +63,7 @@ GATES = {
         ["--check", "--slo", "--mesh", "--overlap", "--cold", "--fleet"],
     ),
     "shard_lint": ("shard_lint.py", ["--check"]),
+    "domain_lint": ("domain_lint.py", ["--check"]),
 }
 
 

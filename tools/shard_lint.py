@@ -36,9 +36,10 @@ and fails on:
                                               # injected violations
     python tools/shard_lint.py --check --json # + machine-readable line
 
-Domains: the code-derived synthetic LCLD schema always (dataset-free);
-the reference lcld/botnet schemas when /root/reference exists (skipped,
-not failed, otherwise — same convention as tools/oracle_check.py).
+Domains: the code-derived synthetic LCLD schema and the spec-compiled
+``phishing`` domain always (both dataset-free); the reference
+lcld/botnet schemas when /root/reference exists (skipped, not failed,
+otherwise — same convention as tools/oracle_check.py).
 """
 
 from __future__ import annotations
@@ -250,6 +251,29 @@ def _synth_problem(tmp_dir: str):
     return cons, x, sur, fit_minmax(x.min(0), x.max(0))
 
 
+def _phishing_problem():
+    """The spec-compiled data-only domain (dataset-free: committed
+    package data + the constraint-first synthetic sampler) — proves a
+    domain with NO hand-written module honours the sharding contract."""
+    from moeva2_ijcai22_replication_tpu.domains import (
+        get_constraints_class,
+        spec_domain_dir,
+    )
+    from moeva2_ijcai22_replication_tpu.domains.synth import synth_phishing
+    from moeva2_ijcai22_replication_tpu.models.io import Surrogate
+    from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+    from moeva2_ijcai22_replication_tpu.models.scalers import fit_minmax
+
+    d = spec_domain_dir("phishing")
+    cons = get_constraints_class("phishing")(
+        os.path.join(d, "features.csv"), os.path.join(d, "constraints.csv")
+    )
+    x = synth_phishing(16, cons.schema, seed=3)
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=1))
+    return cons, x, sur, fit_minmax(x.min(0), x.max(0))
+
+
 def _reference_problem(domain: str):
     import numpy as np
 
@@ -369,7 +393,10 @@ def run_lint(
     violations: list[dict] = []
     linted, skipped = [], []
     with tempfile.TemporaryDirectory() as tmp:
-        problems = {"lcld_synth": _synth_problem(tmp)}
+        problems = {
+            "lcld_synth": _synth_problem(tmp),
+            "phishing": _phishing_problem(),
+        }
         for domain in ("botnet",):
             p = _reference_problem(domain)
             if p is None:

@@ -269,6 +269,17 @@ class Moeva2:
     #: unified event stream. Pure host-side emission between dispatches:
     #: device programs and RNG streams are untouched.
     trace: Any = None
+    #: streaming partial-result sink (``serving`` wires the batcher's
+    #: partial router here): a host-side dispatch knob like ``trace`` —
+    #: NOT engine-cache key material, reset per serving batch by the
+    #: callers. When set, each early-exit gate that parks solved rows
+    #: also decodes JUST those rows' populations (host CPU backend, the
+    #: finalize decode idiom) and calls ``partial_sink(rows, x_ml, gen)``
+    #: with the ORIGINAL row indices — solved rows surface to callers
+    #: before the scan ends. Pure host-side emission at the deferred
+    #: gate flush: device programs, dispatch order, and RNG streams are
+    #: untouched, and ``None`` (the default) does zero extra work.
+    partial_sink: Any = None
     dtype: Any = jnp.float32
     mesh: jax.sharding.Mesh | None = None
     states_axis: str = "states"
@@ -1174,6 +1185,34 @@ class Moeva2:
                     px, pf = jax.device_get((g["px"], g["pf"]))
                     parked["x"][g["park_rows"]] = px
                     parked["f"][g["park_rows"]] = pf
+                if self.partial_sink is not None:
+                    # streaming: decode JUST the newly parked rows on the
+                    # host CPU backend (the finalize decode idiom —
+                    # genetic_to_ml is eager, so no tracked executables)
+                    # and surface them under their ORIGINAL row indices.
+                    # The sink is a consumer boundary: its failures must
+                    # never poison the batch or perturb the scan.
+                    try:
+                        rows = g["park_rows"]
+                        try:
+                            decode_dev = jax.devices("cpu")[0]
+                        except RuntimeError:
+                            decode_dev = None
+                        with maybe_span(
+                            self.trace, "partial_decode", rows=int(len(rows))
+                        ), jax.default_device(decode_dev):
+                            x_ml_rows = np.asarray(
+                                codec_lib.genetic_to_ml(
+                                    self.codec,
+                                    jnp.asarray(px),
+                                    jnp.asarray(x[rows], self.dtype)[:, None, :],
+                                )
+                            )
+                        self.partial_sink(
+                            [int(r) for r in rows], x_ml_rows, int(g["gen"])
+                        )
+                    except Exception:
+                        pass
             if g.get("event") is not None:
                 self._trace_event("moeva.gate", **g["event"])
 

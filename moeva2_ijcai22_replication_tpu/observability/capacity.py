@@ -58,6 +58,8 @@ class _BatchObs:
     rows: int
     run_s: float
     flops: float | None  #: ledger model FLOPs for the dispatch set
+    #: QoS class name -> requests that rode the batch (None pre-QoS)
+    qos: dict | None = None
 
 
 class CapacityModel:
@@ -89,10 +91,13 @@ class CapacityModel:
         rows: int,
         run_s: float,
         flops: float | None,
+        qos_classes: dict | None = None,
     ) -> None:
         """Fold one pure-run batch dispatch into the domain's window.
         Callers must not feed compile-bearing dispatches (their duration
-        is compile wall-clock, not sustainable capacity)."""
+        is compile wall-clock, not sustainable capacity). ``qos_classes``
+        (optional) is the batch's QoS census — class name -> requests —
+        surfaced per domain as ``by_qos_class``."""
         if run_s <= 0 or requests < 1:
             return
         obs = _BatchObs(
@@ -102,6 +107,7 @@ class CapacityModel:
             rows=int(rows),
             run_s=float(run_s),
             flops=float(flops) if flops else None,
+            qos=dict(qos_classes) if qos_classes else None,
         )
         with self._lock:
             dq = self._by_domain.get(domain)
@@ -216,6 +222,17 @@ class CapacityModel:
             c["run_s"] = round(c["run_s"], 6)
             del c["flops"], c["requests_flops"]
 
+        # QoS census over the window: who the served capacity went to
+        by_qos: dict = {}
+        for o in obs:
+            if o.qos:
+                for name, n in o.qos.items():
+                    q = by_qos.setdefault(
+                        name, {"requests": 0, "batches": 0}
+                    )
+                    q["requests"] += int(n)
+                    q["batches"] += 1
+
         return {
             "window_batches": len(obs),
             "window_limit": self.window,
@@ -251,6 +268,7 @@ class CapacityModel:
             ),
             "calibration": calibration,
             "per_class": per_class,
+            **({"by_qos_class": by_qos} if by_qos else {}),
         }
 
     def snapshot(self) -> dict:

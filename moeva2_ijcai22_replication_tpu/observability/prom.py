@@ -65,6 +65,11 @@ _HELP = {
     "quality_gen": "Generation steps executed by the last sampled MoEvA batch",
     "stage_latency_seconds": "Per-request latency by serving stage, fixed log-spaced buckets. Additive end-to-end decomposition: validate + queue_wait + batch_wait + dispatch; device_run/decode are sub-stages INSIDE dispatch (and dispatch includes compile wall-clock on cold batches, which device_run excludes)",
     "shed_requests": "Requests shed or deadline-overrun, by cause and by the stage that consumed the deadline budget",
+    "class_stage_latency_seconds": "Per-request latency by QoS class and serving stage (class-parallel to stage_latency_seconds; present only when serving.qos is on)",
+    "class_shed_requests": "Requests shed by QoS class, cause, and the stage that consumed the deadline budget (domain omitted to bound cardinality)",
+    "qos_admission_admitted": "Requests admitted by the cost-predictive admission controller",
+    "qos_admission_denied": "Requests denied by the cost-predictive admission controller, by QoS class",
+    "capacity_qos_requests": "Requests served per domain and QoS class over the capacity window (who the capacity went to)",
     "capacity_max_sustainable_qps": "Ledger-predicted max sustainable requests/s per domain (achieved FLOP/s over predicted FLOPs per request)",
     "capacity_predicted_flops_per_request": "Predicted model FLOPs per request per domain (cost-ledger entries over the capacity window)",
     "capacity_achieved_flops_s": "Achieved FLOP/s per domain over the capacity window (model FLOPs over attributed run seconds)",
@@ -260,6 +265,33 @@ def _slo_lines(prefix: str, block: dict, lines: list[str]) -> None:
                 )
             lines.append(f"{n}_sum{{{labels}}} {_fmt(snap.get('sum', 0.0))}")
             lines.append(f"{n}_count{{{labels}}} {int(snap.get('count', 0))}")
+    # class-parallel families (present only when serving.qos is on):
+    # the classless families above keep their label sets EXACTLY as
+    # before — QoS adds new families, it never relabels existing ones
+    classes = block.get("classes") or {}
+    class_rows = [
+        (klass, domain, stage, snap)
+        for klass, by_domain in sorted(classes.items())
+        for domain, by_stage in sorted(by_domain.items())
+        for stage, snap in sorted(by_stage.items())
+        if isinstance(snap, dict) and snap.get("buckets")
+    ]
+    if class_rows:
+        n = _name(prefix, "class_stage_latency_seconds")
+        _family(lines, n, "histogram", "class_stage_latency_seconds")
+        for klass, domain, stage, snap in class_rows:
+            labels = (
+                f'class="{_escape_label(klass)}",'
+                f'domain="{_escape_label(domain)}",'
+                f'stage="{_escape_label(stage)}"'
+            )
+            for le, cum in snap["buckets"]:
+                le_txt = "+Inf" if le == "+Inf" else _fmt(le)
+                lines.append(
+                    f'{n}_bucket{{{labels},le="{le_txt}"}} {int(cum)}'
+                )
+            lines.append(f"{n}_sum{{{labels}}} {_fmt(snap.get('sum', 0.0))}")
+            lines.append(f"{n}_count{{{labels}}} {int(snap.get('count', 0))}")
     shed = (block.get("shed") or {}).get("by_domain") or {}
     shed_rows = [
         (domain, cause, stage, v)
@@ -274,6 +306,23 @@ def _slo_lines(prefix: str, block: dict, lines: list[str]) -> None:
         for domain, cause, stage, v in shed_rows:
             lines.append(
                 f'{n}{{domain="{_escape_label(domain)}",'
+                f'cause="{_escape_label(cause)}",'
+                f'stage="{_escape_label(stage)}"}} {v}'
+            )
+    class_shed = (block.get("shed") or {}).get("by_class") or {}
+    class_shed_rows = [
+        (klass, cause, stage, v)
+        for klass, by_cause in sorted(class_shed.items())
+        for cause, by_stage in sorted(by_cause.items())
+        for stage, v in sorted(by_stage.items())
+        if isinstance(v, int)
+    ]
+    if class_shed_rows:
+        n = _name(prefix, "class_shed_requests", "_total")
+        _family(lines, n, "counter", "class_shed_requests")
+        for klass, cause, stage, v in class_shed_rows:
+            lines.append(
+                f'{n}{{class="{_escape_label(klass)}",'
                 f'cause="{_escape_label(cause)}",'
                 f'stage="{_escape_label(stage)}"}} {v}'
             )
@@ -319,6 +368,20 @@ def _capacity_lines(prefix: str, block: dict, lines: list[str]) -> None:
         _family(lines, n, "gauge", "capacity_calibration_error")
         for domain, v in cal_rows:
             lines.append(f'{n}{{domain="{_escape_label(domain)}"}} {_fmt(v)}')
+    qos_rows = [
+        (domain, klass, (slot or {}).get("requests"))
+        for domain, d in sorted(by_domain.items())
+        for klass, slot in sorted((d.get("by_qos_class") or {}).items())
+        if isinstance((slot or {}).get("requests"), int)
+    ]
+    if qos_rows:
+        n = _name(prefix, "capacity_qos_requests", "_total")
+        _family(lines, n, "counter", "capacity_qos_requests")
+        for domain, klass, v in qos_rows:
+            lines.append(
+                f'{n}{{domain="{_escape_label(domain)}",'
+                f'class="{_escape_label(klass)}"}} {_fmt(v)}'
+            )
 
 
 def _mesh_lines(prefix: str, block: dict, lines: list[str]) -> None:
@@ -497,6 +560,30 @@ def _coldstart_lines(prefix: str, block: dict, lines: list[str]) -> None:
         lines.append(f"{n} {_fmt(ttfd)}")
 
 
+def _qos_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """QoS exposition: the admission controller's admit/deny counters
+    (denials ``{class}``-labeled — the cause x class attribution a
+    dashboard alerts on). Class-labeled latency/shed families render from
+    the SLO block; capacity's per-class census from the capacity block."""
+    admission = block.get("admission") or {}
+    v = admission.get("admitted")
+    if isinstance(v, int):
+        n = _name(prefix, "qos_admission_admitted", "_total")
+        _family(lines, n, "counter", "qos_admission_admitted")
+        lines.append(f"{n} {_fmt(v)}")
+    denied_by_class = admission.get("denied_by_class") or {}
+    rows = [
+        (klass, v)
+        for klass, v in sorted(denied_by_class.items())
+        if isinstance(v, int)
+    ]
+    if rows:
+        n = _name(prefix, "qos_admission_denied", "_total")
+        _family(lines, n, "counter", "qos_admission_denied")
+        for klass, v in rows:
+            lines.append(f'{n}{{class="{_escape_label(klass)}"}} {_fmt(v)}')
+
+
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     """ServiceMetrics snapshot dict -> Prometheus exposition text."""
     lines: list[str] = []
@@ -522,6 +609,9 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     coldstart = snapshot.get("coldstart")
     if isinstance(coldstart, dict):
         _coldstart_lines(prefix, coldstart, lines)
+    qos = snapshot.get("qos")
+    if isinstance(qos, dict):
+        _qos_lines(prefix, qos, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
@@ -553,7 +643,7 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     for key, v in sorted(snapshot.items()):
         if key in (
             "counters", "gauges", "streams", "cost_ledger", "quality",
-            "slo", "capacity", "mesh", "gaps", "coldstart",
+            "slo", "capacity", "mesh", "gaps", "coldstart", "qos",
         ):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):

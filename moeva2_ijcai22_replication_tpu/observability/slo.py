@@ -207,16 +207,32 @@ class SloTracker:
         self._lock = threading.Lock()
         self._hists: dict[tuple[str, str], Histogram] = {}
         self._shed: dict[tuple[str, str, str], int] = {}
+        # QoS-class parallel families (populated only by class-labelled
+        # observations — a classless service never allocates here, and
+        # every pre-QoS export key above stays byte-identical). Class
+        # shed omits the domain dimension to bound label cardinality:
+        # cause x stage x class answers "who absorbed the overload",
+        # the per-domain split stays on the classless family.
+        self._class_hists: dict[tuple[str, str, str], Histogram] = {}
+        self._class_shed: dict[tuple[str, str, str], int] = {}
 
     # -- ingestion -----------------------------------------------------------
     def observe(
-        self, domain: str, stage: str, seconds: float, count: int = 1
+        self,
+        domain: str,
+        stage: str,
+        seconds: float,
+        count: int = 1,
+        qos_class: str | None = None,
     ) -> None:
         """Fold one stage latency in, ``count`` times: per-batch stages
         (device_run, decode) pass the requests that rode the batch so
         every stage in the family is request-weighted — a family mixing
         per-request and per-batch populations would break the per-stage
-        decomposition its p99s exist for."""
+        decomposition its p99s exist for. ``qos_class`` additionally
+        folds the observation into the per-class parallel family (the
+        classless family always receives it — class views are a
+        refinement, not a partition swap)."""
         if not self.enabled:
             return
         key = (str(domain), str(stage))
@@ -225,16 +241,33 @@ class SloTracker:
             if h is None:
                 h = self._hists[key] = Histogram(self.bounds)
             h.observe(seconds, count)
+            if qos_class is not None:
+                ck = (str(qos_class), str(domain), str(stage))
+                ch = self._class_hists.get(ck)
+                if ch is None:
+                    ch = self._class_hists[ck] = Histogram(self.bounds)
+                ch.observe(seconds, count)
 
-    def shed(self, domain: str, cause: str, stage: str) -> None:
+    def shed(
+        self,
+        domain: str,
+        cause: str,
+        stage: str,
+        qos_class: str | None = None,
+    ) -> None:
         """Count one shed/deadline event: ``cause`` from
         :data:`SHED_CAUSES`, ``stage`` = the stage that consumed the
-        request's deadline budget (or where the shed happened)."""
+        request's deadline budget (or where the shed happened).
+        ``qos_class`` additionally attributes the event to the per-class
+        shed matrix (cause x class is the QoS layer's overload proof)."""
         if not self.enabled:
             return
         key = (str(domain), str(cause), str(stage))
         with self._lock:
             self._shed[key] = self._shed.get(key, 0) + 1
+            if qos_class is not None:
+                ck = (str(qos_class), str(cause), str(stage))
+                self._class_shed[ck] = self._class_shed.get(ck, 0) + 1
 
     # -- windowing -----------------------------------------------------------
     def mark(self) -> dict:
@@ -244,42 +277,80 @@ class SloTracker:
             return {
                 "hists": {k: h.state() for k, h in self._hists.items()},
                 "shed": dict(self._shed),
+                "class_hists": {
+                    k: h.state() for k, h in self._class_hists.items()
+                },
+                "class_shed": dict(self._class_shed),
             }
 
     # -- export --------------------------------------------------------------
     def shed_block(self, since: dict | None = None) -> dict:
         prev = (since or {}).get("shed", {})
+        prev_class = (since or {}).get("class_shed", {})
         with self._lock:
             items = {
                 k: n - prev.get(k, 0)
                 for k, n in self._shed.items()
                 if n - prev.get(k, 0) > 0
             }
+            class_items = {
+                k: n - prev_class.get(k, 0)
+                for k, n in self._class_shed.items()
+                if n - prev_class.get(k, 0) > 0
+            }
         by_domain: dict = {}
         for (domain, cause, stage), n in sorted(items.items()):
             by_domain.setdefault(domain, {}).setdefault(cause, {})[stage] = n
-        return {"total": sum(items.values()), "by_domain": by_domain}
+        out = {"total": sum(items.values()), "by_domain": by_domain}
+        if class_items:
+            by_class: dict = {}
+            for (klass, cause, stage), n in sorted(class_items.items()):
+                by_class.setdefault(klass, {}).setdefault(cause, {})[
+                    stage
+                ] = n
+            out["by_class"] = by_class
+        return out
 
     def snapshot(self, since: dict | None = None) -> dict:
         prev = (since or {}).get("hists", {})
+        prev_class = (since or {}).get("class_hists", {})
         # histogram states are read under the SAME lock observe() mutates
         # them under — a scrape racing an observation must never export a
         # torn histogram (+Inf bucket != count breaks the mergeability
         # contract, and a windowed delta could even go negative)
         with self._lock:
             hists = {k: (h, h.state()) for k, h in self._hists.items()}
+            class_hists = {
+                k: (h, h.state()) for k, h in self._class_hists.items()
+            }
         stages: dict = {}
         for (domain, stage), (h, state) in sorted(hists.items()):
             snap = h.snapshot(since=prev.get((domain, stage)), state=state)
             if since is not None and snap["count"] == 0:
                 continue  # stage saw no traffic in the window
             stages.setdefault(domain, {})[stage] = snap
-        return {
+        out = {
             "enabled": self.enabled,
             "bucket_bounds": list(self.bounds),
             "stages": stages,
             "shed": self.shed_block(since=since),
         }
+        if class_hists:
+            classes: dict = {}
+            for (klass, domain, stage), (h, state) in sorted(
+                class_hists.items()
+            ):
+                snap = h.snapshot(
+                    since=prev_class.get((klass, domain, stage)), state=state
+                )
+                if since is not None and snap["count"] == 0:
+                    continue
+                classes.setdefault(klass, {}).setdefault(domain, {})[
+                    stage
+                ] = snap
+            if classes:
+                out["classes"] = classes
+        return out
 
 
 def merge_histogram_snapshots(snaps: list[dict]) -> dict | None:
@@ -334,6 +405,13 @@ def merge_slo_snapshots(snaps: list[dict]) -> dict:
     mergeability contract — ``serving.slo_histogram_buckets`` must match
     across a pooled fleet, and the build-identity check at adoption
     enforces the config hash that carries it). Shed counters add.
+
+    QoS-class families merge under the same discipline, with one more
+    label check: a replica that carries stage traffic but NO class view
+    while another replica carries one has a mismatched label set (a
+    mixed-version fleet mid-rollout) — its class data can't be invented,
+    so it is dropped from the CLASS view only and counted in
+    ``skipped_mismatched_labels`` (its classless families still merge).
     """
     snaps = [s for s in snaps if isinstance(s, dict)]
     stages_in: dict[tuple[str, str], list[dict]] = {}
@@ -349,7 +427,33 @@ def merge_slo_snapshots(snaps: list[dict]) -> dict:
             skipped += 1
             continue
         stages.setdefault(domain, {})[stage] = merged
+    # class families: merge only across replicas that export the class
+    # label at all; label-set mismatches are counted, never guessed at
+    class_carriers = [s for s in snaps if isinstance(s.get("classes"), dict)]
+    skipped_labels = 0
+    if class_carriers:
+        skipped_labels = sum(
+            1
+            for s in snaps
+            if not isinstance(s.get("classes"), dict) and s.get("stages")
+        )
+    classes_in: dict[tuple[str, str, str], list[dict]] = {}
+    for s in class_carriers:
+        for klass, by_domain in s["classes"].items():
+            for domain, by_stage in (by_domain or {}).items():
+                for stage, hist in (by_stage or {}).items():
+                    classes_in.setdefault(
+                        (klass, domain, stage), []
+                    ).append(hist)
+    classes: dict = {}
+    for (klass, domain, stage), hists in sorted(classes_in.items()):
+        merged = merge_histogram_snapshots(hists)
+        if merged is None:
+            skipped += 1
+            continue
+        classes.setdefault(klass, {}).setdefault(domain, {})[stage] = merged
     shed_by_domain: dict = {}
+    shed_by_class: dict = {}
     shed_total = 0
     for s in snaps:
         shed = s.get("shed") or {}
@@ -361,18 +465,32 @@ def merge_slo_snapshots(snaps: list[dict]) -> dict:
                         cause, {}
                     )
                     tgt[stage] = tgt.get(stage, 0) + int(n)
+        for klass, by_cause in (shed.get("by_class") or {}).items():
+            for cause, by_stage in (by_cause or {}).items():
+                for stage, n in (by_stage or {}).items():
+                    tgt = shed_by_class.setdefault(klass, {}).setdefault(
+                        cause, {}
+                    )
+                    tgt[stage] = tgt.get(stage, 0) + int(n)
     bounds = next(
         (list(s["bucket_bounds"]) for s in snaps if s.get("bucket_bounds")),
         [],
     )
-    return {
+    shed_out: dict = {"total": shed_total, "by_domain": shed_by_domain}
+    if shed_by_class:
+        shed_out["by_class"] = shed_by_class
+    out = {
         "enabled": any(s.get("enabled") for s in snaps),
         "bucket_bounds": bounds,
         "stages": stages,
-        "shed": {"total": shed_total, "by_domain": shed_by_domain},
+        "shed": shed_out,
         "merged_from": len(snaps),
         "skipped_mismatched_bounds": skipped,
+        "skipped_mismatched_labels": skipped_labels,
     }
+    if classes:
+        out["classes"] = classes
+    return out
 
 
 def detect_knee(
@@ -476,6 +594,8 @@ def slo_block(
         "shed": snap["shed"],
         "knee": knee if knee is not None else {},
     }
+    if snap.get("classes"):
+        block["classes"] = snap["classes"]
     if capacity is not None:
         block["capacity"] = capacity
     return block

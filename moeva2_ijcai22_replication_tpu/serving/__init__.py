@@ -12,7 +12,11 @@ JSON/HTTP front; ``serving.sweep`` is the offered-load harness behind
 ``bench.py --serving``; ``serving.fleet`` scales the whole stack out —
 N replica processes sharing one AOT/artifact cache directory behind a
 capacity-driven router with add/drain lifecycle and a chaos-proof fleet
-sweep (``bench.py --fleet``).
+sweep (``bench.py --fleet``). ``serving.qos`` adds the QoS layer on top:
+priority classes with weighted-fair assembly (:class:`QosPolicy`),
+cost-predictive admission from the capacity model
+(:class:`AdmissionController`), and streaming partial results
+(:class:`ResultStream`) fed by the MoEvA early-exit gate.
 """
 
 from .batcher import (
@@ -30,9 +34,11 @@ from .fleet import (
     Router,
     serve_router,
 )
+from .qos import AdmissionController, QosClass, QosPolicy, ResultStream
 from .service import AttackRequest, AttackResponse, AttackService, InvalidRequest
 
 __all__ = [
+    "AdmissionController",
     "AttackRequest",
     "AttackResponse",
     "AttackService",
@@ -42,10 +48,13 @@ __all__ = [
     "DeadlineExceeded",
     "InvalidRequest",
     "Microbatcher",
+    "QosClass",
+    "QosPolicy",
     "QueueFull",
     "ReplicaHandle",
     "ReplicaManager",
     "RequestTooLarge",
+    "ResultStream",
     "Router",
     "serve_router",
 ]

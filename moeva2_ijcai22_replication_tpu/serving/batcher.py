@@ -91,6 +91,16 @@ class _Pending:
     #: the request's observability trace (None when tracing is off — the
     #: batcher then does zero trace work for this request)
     trace: Trace | None = None
+    #: QoS class name (None when the batcher runs without a policy)
+    qos_class: str | None = None
+    #: the instant assembly first REACHED this request but closed the
+    #: batch without it (it no longer waits for batch-mates to arrive,
+    #: it waits for batch formation) — deadline expiry after this
+    #: instant is attributed to batch_wait, not queue_wait
+    batched_at: float | None = None
+    #: partial-result sink: called with (local_rows, x_rows, gen) as
+    #: solved rows belonging to this request surface mid-dispatch
+    on_partial: Callable | None = None
 
 
 @dataclass
@@ -98,6 +108,21 @@ class _KeyQueue:
     dispatch: Callable[[np.ndarray], np.ndarray]
     requests: collections.deque = field(default_factory=collections.deque)
     rows_queued: int = 0
+    #: QoS mode only: class name -> FIFO deque (``requests`` unused).
+    #: None = classless mode, the exact pre-QoS single-deque path.
+    by_class: dict[str, collections.deque] | None = None
+
+    def empty(self) -> bool:
+        if self.by_class is not None:
+            return all(not dq for dq in self.by_class.values())
+        return not self.requests
+
+    def heads(self) -> list[_Pending]:
+        """The oldest request of each FIFO lane (one lane per class in
+        QoS mode, a single lane otherwise)."""
+        if self.by_class is not None:
+            return [dq[0] for dq in self.by_class.values() if dq]
+        return [self.requests[0]] if self.requests else []
 
 
 class Microbatcher:
@@ -114,10 +139,19 @@ class Microbatcher:
         clock: Callable[[], float] | None = None,
         start: bool = True,
         retry_after_fn: Callable[[int], float | None] | None = None,
+        qos=None,
     ):
         import time
 
         self.menu = menu
+        #: QoS policy (``serving.qos.QosPolicy`` or None). None = the
+        #: exact pre-QoS path: single FIFO lane per key, no class
+        #: bookkeeping anywhere. With a policy, each key grows one FIFO
+        #: lane per class, assembly runs weighted-fairness seats then
+        #: strict-priority fill, and flush dispatches batches in
+        #: priority order (preemption: a flushable high-priority batch
+        #: never waits behind a low-priority one).
+        self.qos = qos
         self.max_delay_s = float(max_delay_s)
         self.max_queue_rows = int(max_queue_rows)
         self.metrics = metrics
@@ -161,6 +195,8 @@ class Microbatcher:
         deadline_s: float | None = None,
         meta: dict | None = None,
         trace: Trace | None = None,
+        qos_class: str | None = None,
+        on_partial: Callable | None = None,
     ) -> Future:
         """Queue ``rows`` under ``key``; resolves to ``(result_rows, meta)``.
 
@@ -168,7 +204,10 @@ class Microbatcher:
         requests under one key must share it — the service guarantees this
         by deriving the key from everything the closure captures). ``trace``
         (optional) receives the request's queue_wait/batch spans and rides
-        back in the result meta as a span tree.
+        back in the result meta as a span tree. ``qos_class`` (QoS mode
+        only) picks the request's FIFO lane; ``on_partial`` receives
+        ``(local_rows, x_rows, gen)`` as this request's solved rows
+        surface mid-dispatch (streaming partial results).
         """
         rows = np.asarray(rows)
         n = rows.shape[0]
@@ -180,6 +219,13 @@ class Microbatcher:
                 "split the request"
             )
         now = self.clock()
+        if self.qos is not None:
+            # the service resolves names/tenants; anything unresolved
+            # still lands in a valid lane (the policy default)
+            if not qos_class or qos_class not in self.qos.classes:
+                qos_class = self.qos.default_class
+        else:
+            qos_class = None
         pending = _Pending(
             rows=rows,
             n=n,
@@ -188,6 +234,8 @@ class Microbatcher:
             deadline_at=None if deadline_s is None else now + float(deadline_s),
             meta=dict(meta or {}),
             trace=trace,
+            qos_class=qos_class,
+            on_partial=on_partial,
         )
         with self._cond:
             if self._stop:
@@ -217,8 +265,16 @@ class Microbatcher:
                 )
             q = self._queues.get(key)
             if q is None:
-                q = self._queues[key] = _KeyQueue(dispatch=dispatch)
-            q.requests.append(pending)
+                q = self._queues[key] = _KeyQueue(
+                    dispatch=dispatch,
+                    by_class={} if self.qos is not None else None,
+                )
+            if q.by_class is not None:
+                q.by_class.setdefault(
+                    qos_class, collections.deque()
+                ).append(pending)
+            else:
+                q.requests.append(pending)
             q.rows_queued += n
             self._rows_total += n
             if self.metrics:
@@ -231,62 +287,133 @@ class Microbatcher:
 
     # -- flushing ------------------------------------------------------------
     def _due(self, key: Any, q: _KeyQueue, now: float, force: bool) -> bool:
-        if not q.requests:
+        heads = q.heads()
+        if not heads:
             return False
         if force or q.rows_queued >= self.menu.max_size:
             return True
-        head = q.requests[0]
-        return now - head.enqueued_at >= self.max_delay_s or (
-            head.deadline_at is not None and head.deadline_at <= now
-        )
+        for head in heads:
+            if now - head.enqueued_at >= self.max_delay_s or (
+                head.deadline_at is not None and head.deadline_at <= now
+            ):
+                return True
+        return False
 
     def _next_deadline(self, now: float) -> float | None:
         """Seconds until the nearest flush obligation, None when idle."""
         nearest = None
         for q in self._queues.values():
-            if not q.requests:
+            if q.empty():
                 continue
             if q.rows_queued >= self.menu.max_size:
                 return 0.0
-            head = q.requests[0]
-            t = head.enqueued_at + self.max_delay_s
-            if head.deadline_at is not None:
-                t = min(t, head.deadline_at)
-            nearest = t if nearest is None else min(nearest, t)
+            for head in q.heads():
+                t = head.enqueued_at + self.max_delay_s
+                if head.deadline_at is not None:
+                    t = min(t, head.deadline_at)
+                nearest = t if nearest is None else min(nearest, t)
         return None if nearest is None else max(0.0, nearest - now)
+
+    def _cancel_if_expired(self, p: _Pending, now: float) -> bool:
+        """Cancel a just-popped request whose deadline already passed."""
+        if p.deadline_at is None or p.deadline_at > now:
+            return False
+        if self.metrics:
+            self.metrics.count("timeouts")
+        if self.slo is not None:
+            # attribute the expiry to the stage that actually consumed
+            # the deadline: once assembly reached the request but closed
+            # the batch without it (batched_at), its remaining wait is
+            # batch formation, not queueing — a deadline instant past
+            # that mark sheds as batch_wait
+            stage = (
+                "batch_wait"
+                if p.batched_at is not None and p.deadline_at > p.batched_at
+                else "queue_wait"
+            )
+            self.slo.shed(
+                p.meta.get("domain"), "expired", stage, qos_class=p.qos_class
+            )
+        if p.trace is not None:
+            p.trace.event(
+                "cancelled",
+                reason="deadline",
+                queued_s=round(now - p.enqueued_at, 6),
+            )
+        p.future.set_exception(
+            DeadlineExceeded(
+                f"deadline passed after {now - p.enqueued_at:.3f}s in "
+                "queue; cancelled before dispatch"
+            )
+        )
+        return True
 
     def _assemble(self, key: Any, q: _KeyQueue, now: float):
         """Pop one FIFO batch for ``key``; cancels expired requests."""
+        if q.by_class is not None:
+            return self._assemble_qos(key, q, now)
         batch: list[_Pending] = []
         rows_total = 0
         while q.requests and rows_total + q.requests[0].n <= self.menu.max_size:
             p = q.requests.popleft()
             q.rows_queued -= p.n
             self._rows_total -= p.n
-            if p.deadline_at is not None and p.deadline_at <= now:
-                if self.metrics:
-                    self.metrics.count("timeouts")
-                if self.slo is not None:
-                    # the whole deadline budget went to queueing: the
-                    # request never left the queue
-                    self.slo.shed(
-                        p.meta.get("domain"), "expired", "queue_wait"
-                    )
-                if p.trace is not None:
-                    p.trace.event(
-                        "cancelled",
-                        reason="deadline",
-                        queued_s=round(now - p.enqueued_at, 6),
-                    )
-                p.future.set_exception(
-                    DeadlineExceeded(
-                        f"deadline passed after {now - p.enqueued_at:.3f}s in "
-                        "queue; cancelled before dispatch"
-                    )
-                )
+            if self._cancel_if_expired(p, now):
                 continue
             batch.append(p)
             rows_total += p.n
+        if batch and q.requests:
+            # the head was reached but the batch closed without it: from
+            # here on it waits for batch formation, not batch-mates
+            head = q.requests[0]
+            if head.batched_at is None:
+                head.batched_at = now
+        return batch, rows_total
+
+    def _assemble_qos(self, key: Any, q: _KeyQueue, now: float):
+        """Class-aware assembly: weighted seats, then strict priority.
+
+        Pass 1 guarantees every class with queued work
+        ``floor(capacity * weight / sum(present weights))`` rows, popped
+        FIFO, visiting classes in priority order — the starvation bound:
+        scavenger work is guaranteed its slice of EVERY batch its key
+        flushes, no matter how hot the interactive lane runs. Pass 2
+        hands the leftover capacity out in strict priority order.
+        """
+        cap = self.menu.max_size
+        batch: list[_Pending] = []
+        rows_total = 0
+
+        def pop_from(dq: collections.deque, limit_rows: int) -> None:
+            nonlocal rows_total
+            taken = 0
+            while (
+                dq
+                and taken + dq[0].n <= limit_rows
+                and rows_total + dq[0].n <= cap
+            ):
+                p = dq.popleft()
+                q.rows_queued -= p.n
+                self._rows_total -= p.n
+                if self._cancel_if_expired(p, now):
+                    continue
+                batch.append(p)
+                rows_total += p.n
+                taken += p.n
+
+        order = [c for c in self.qos.ordered() if q.by_class.get(c.name)]
+        w_sum = sum(c.weight for c in order)
+        if w_sum > 0:
+            for c in order:
+                pop_from(
+                    q.by_class[c.name], int(cap * c.weight / w_sum)
+                )
+        for c in order:
+            pop_from(q.by_class[c.name], cap)
+        if batch:
+            for dq in q.by_class.values():
+                if dq and dq[0].batched_at is None:
+                    dq[0].batched_at = now
         return batch, rows_total
 
     def flush_due(self, now: float | None = None, force: bool = False) -> int:
@@ -311,10 +438,19 @@ class Microbatcher:
                         todo.append((key, q.dispatch, batch, rows_total, now))
                 # drop drained queues: the key space is client-controlled
                 # (ε sweeps), so idle keys must not accumulate flusher work
-                if not q.requests:
+                if q.empty():
                     del self._queues[key]
             if self.metrics:
                 self.metrics.gauge("queue_depth_rows", self._rows_total)
+        if self.qos is not None and len(todo) > 1:
+            # preemption at flush: a flushable high-priority batch never
+            # waits behind a low-priority one from another key (stable
+            # sort — equal-priority batches keep assembly order)
+            todo.sort(
+                key=lambda t: min(
+                    self.qos.priority_of(p.qos_class) for p in t[2]
+                )
+            )
         for key, dispatch, batch, rows_total, t_asm in todo:
             self._dispatch(key, dispatch, batch, rows_total, t_asm)
         return len(todo)
@@ -350,18 +486,28 @@ class Microbatcher:
                     p.trace.recorder, trace_id=f"batch-{seq}", record=False
                 )
                 break
+        # every executable compiled under this dispatch records the
+        # bucket it was built for — the cost ledger's serving identity;
+        # batch_rows is the REAL (pre-padding) row count, what the
+        # capacity model must count as served (the dispatch closure
+        # only ever sees the bucket-padded array)
+        ctx: dict[str, Any] = dict(
+            bucket=int(bucket),
+            batch_requests=len(batch),
+            batch_rows=int(rows_total),
+        )
+        if any(p.qos_class for p in batch):
+            census: dict[str, int] = {}
+            for p in batch:
+                k = p.qos_class or "(none)"
+                census[k] = census.get(k, 0) + 1
+            ctx["batch_classes"] = census
+        router = self._partial_router(batch)
+        if router is not None:
+            ctx["partial_router"] = router
         t0 = self.clock()
         try:
-            # every executable compiled under this dispatch records the
-            # bucket it was built for — the cost ledger's serving identity;
-            # batch_rows is the REAL (pre-padding) row count, what the
-            # capacity model must count as served (the dispatch closure
-            # only ever sees the bucket-padded array)
-            with ledger_context(
-                bucket=int(bucket),
-                batch_requests=len(batch),
-                batch_rows=int(rows_total),
-            ):
+            with ledger_context(**ctx):
                 if bt is None:
                     out = np.asarray(dispatch(x_pad))
                 else:
@@ -383,7 +529,12 @@ class Microbatcher:
             err = BatchExecutionError(key, e)
             for p in batch:
                 if self.slo is not None:
-                    self.slo.shed(p.meta.get("domain"), "poisoned", "dispatch")
+                    self.slo.shed(
+                        p.meta.get("domain"),
+                        "poisoned",
+                        "dispatch",
+                        qos_class=p.qos_class,
+                    )
                 if p.trace is not None:
                     p.trace.event("batch_failed", batch_seq=seq, error=repr(e))
                 p.future.set_exception(err)
@@ -413,11 +564,14 @@ class Microbatcher:
                 batch_wait_s=round(batch_wait, 6),
                 dispatch_s=round(dt, 6),
             )
+            if p.qos_class is not None:
+                meta["qos_class"] = p.qos_class
             if self.slo is not None:
                 domain = p.meta.get("domain")
-                self.slo.observe(domain, "queue_wait", queue_wait)
-                self.slo.observe(domain, "batch_wait", batch_wait)
-                self.slo.observe(domain, "dispatch", dt)
+                kl = p.qos_class
+                self.slo.observe(domain, "queue_wait", queue_wait, qos_class=kl)
+                self.slo.observe(domain, "batch_wait", batch_wait, qos_class=kl)
+                self.slo.observe(domain, "dispatch", dt, qos_class=kl)
                 if p.deadline_at is not None and p.deadline_at <= t_done:
                     # completed, but past its deadline: attribute the
                     # overrun to the stage the deadline instant fell in.
@@ -428,7 +582,7 @@ class Microbatcher:
                     stage = (
                         "batch_wait" if p.deadline_at <= t0 else "device_run"
                     )
-                    self.slo.shed(domain, "overrun", stage)
+                    self.slo.shed(domain, "overrun", stage, qos_class=kl)
             if p.trace is not None and p.trace.enabled:
                 # the request's own waits (batcher clock), then the shared
                 # batch spans re-stamped under the request's trace id — one
@@ -442,6 +596,42 @@ class Microbatcher:
                 meta["trace"] = p.trace.tree()
             p.future.set_result((out[off : off + p.n].copy(), meta))
             off += p.n
+
+    @staticmethod
+    def _partial_router(batch: list[_Pending]):
+        """Map batch-global solved rows back to each streaming rider.
+
+        Returns a callable ``(rows, x_rows, gen)`` — ``rows`` are row
+        indices in the CONCATENATED (pre-padding) batch, ``x_rows`` the
+        aligned decoded payloads — or None when no rider streams (the
+        common case: the dispatch then carries no partial plumbing at
+        all). Padding rows are beyond every rider's slice and never
+        route. A broken consumer sink must never poison the batch, so
+        sink errors are swallowed.
+        """
+        sinks = []
+        off = 0
+        for p in batch:
+            if p.on_partial is not None:
+                sinks.append((off, off + p.n, p.on_partial))
+            off += p.n
+        if not sinks:
+            return None
+
+        def route(rows, x_rows, gen):
+            for lo, hi, sink in sinks:
+                local, sel = [], []
+                for i, r in enumerate(rows):
+                    if lo <= r < hi:
+                        local.append(int(r - lo))
+                        sel.append(i)
+                if local:
+                    try:
+                        sink(local, x_rows[np.asarray(sel)], int(gen))
+                    except Exception:  # noqa: BLE001 — consumer boundary
+                        pass
+
+        return route
 
     # -- lifecycle -----------------------------------------------------------
     def _run(self):

@@ -51,24 +51,50 @@ from ...observability.prom import _family, _fmt, _name, _slo_lines
 from ...observability.slo import merge_slo_snapshots
 from .replica import ReplicaHandle, ReplicaManager
 
-__all__ = ["Router", "RouterHTTPServer", "serve_router", "default_http_post"]
+__all__ = [
+    "Router",
+    "RouterHTTPServer",
+    "serve_router",
+    "default_http_post",
+    "default_http_get_raw",
+]
 
 #: upstream statuses that are safe + useful to retry on another replica
 RETRYABLE_STATUSES = frozenset({429, 500, 502, 503})
 
 
 def default_http_post(
-    url: str, body: bytes, timeout_s: float = 120.0
+    url: str,
+    body: bytes,
+    timeout_s: float = 120.0,
+    headers: dict | None = None,
 ) -> tuple[int, dict, bytes]:
     """POST ``body`` as JSON; returns ``(status, headers, body)`` without
     raising on HTTP error statuses (the router maps them itself).
     Connection-level failures still raise (``URLError``/``OSError``) —
-    that distinction is the router's "failed" vs "rejected" cause split."""
+    that distinction is the router's "failed" vs "rejected" cause split.
+    ``headers`` adds/overrides request headers (the router forwards
+    ``X-Qos-Class`` through it)."""
     req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"}
+        url,
+        data=body,
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+def default_http_get_raw(
+    url: str, timeout_s: float = 30.0
+) -> tuple[int, dict, bytes]:
+    """GET returning ``(status, headers, body)`` without raising on HTTP
+    error statuses — the stream-poll forwarder needs the raw 404 to probe
+    for the replica holding a stream."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
             return resp.status, dict(resp.headers), resp.read()
     except urllib.error.HTTPError as e:
         return e.code, dict(e.headers or {}), e.read()
@@ -86,9 +112,11 @@ class Router:
         capacity_age_max_s: float = 30.0,
         request_timeout_s: float = 120.0,
         http_post: Callable[..., tuple[int, dict, bytes]] = default_http_post,
+        http_get_raw: Callable[..., tuple[int, dict, bytes]] = default_http_get_raw,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.manager = manager
+        self.http_get_raw = http_get_raw
         self.retry_budget = int(retry_budget)
         self.stale_after_s = float(stale_after_s)
         self.capacity_age_max_s = float(capacity_age_max_s)
@@ -148,10 +176,23 @@ class Router:
         return [h for _, h in scored] + tail
 
     # -- forwarding -----------------------------------------------------------
-    def route(self, body: bytes) -> tuple[int, dict, bytes]:
+    def route(
+        self,
+        body: bytes,
+        *,
+        path: str = "/attack",
+        req_headers: dict | None = None,
+    ) -> tuple[int, dict, bytes]:
         """Forward one /attack body; returns ``(status, headers, body)``.
         Headers include ``X-Served-By`` (the replica that produced the
-        returned response) and ``X-Fleet-Attempts``."""
+        returned response) and ``X-Fleet-Attempts``. ``path`` carries the
+        client's full path+query (``/attack?stream=poll`` reaches the
+        replica intact; a ``stream=1`` chunked reply is buffered by the
+        forwarder and delivered whole — poll mode is the streaming path
+        that stays incremental through the router). ``req_headers``
+        forwards end-to-end request headers — the QoS class rides
+        ``X-Qos-Class`` so per-class accounting on the replica matches
+        what the client asked the fleet for."""
         order = self.candidates()
         if not order:
             self._count("shed_no_replica")
@@ -169,10 +210,14 @@ class Router:
                 self._count("retries")
             self.manager.note_inflight(handle.replica_id, +1)
             try:
+                # extra kwargs only when needed: injected test doubles
+                # predating the QoS header keep their 3-arg signature
+                kw = {"headers": req_headers} if req_headers else {}
                 status, headers, resp_body = self.http_post(
-                    handle.url + "/attack",
+                    handle.url + path,
                     body,
                     timeout_s=self.request_timeout_s,
+                    **kw,
                 )
             except Exception:  # noqa: BLE001 — connection-level failure
                 # dead/unreachable replica: the chaos path. Count the
@@ -216,12 +261,50 @@ class Router:
         out = {
             k: v
             for k, v in headers.items()
-            if k.lower() in ("retry-after", "x-replica-id")
+            if k.lower() in ("retry-after", "x-replica-id", "x-qos-class")
         }
         if replica_id:
             out["X-Served-By"] = str(replica_id)
         out["X-Fleet-Attempts"] = str(attempts)
         return status, out, body
+
+    def route_poll(self, path: str) -> tuple[int, dict, bytes]:
+        """Forward one ``GET /attack/<id>`` stream poll. The router keeps
+        no stream-affinity table (streams live in the memory of the
+        replica that ran the request), so it probes candidates in routing
+        order and returns the first non-404 answer — a 404 from every
+        routable replica means the stream is genuinely unknown or
+        evicted."""
+        order = self.candidates()
+        if not order:
+            self._count("shed_no_replica")
+            return (
+                503,
+                {"X-Fleet-Attempts": "0"},
+                json.dumps({"error": "no routable replica"}).encode(),
+            )
+        attempts = 0
+        last: tuple[int, dict, bytes] | None = None
+        last_rid = None
+        for handle in order:
+            attempts += 1
+            try:
+                status, headers, resp_body = self.http_get_raw(
+                    handle.url + path, timeout_s=self.request_timeout_s
+                )
+            except Exception:  # noqa: BLE001 — dead replica: keep probing
+                continue
+            last = (status, headers, resp_body)
+            last_rid = handle.replica_id
+            if status != 404:
+                return self._stamp(last, last_rid, attempts)
+        if last is None:
+            return (
+                502,
+                {"X-Fleet-Attempts": str(attempts)},
+                json.dumps({"error": "all replicas unreachable"}).encode(),
+            )
+        return self._stamp(last, last_rid, attempts)
 
     # -- aggregated views -----------------------------------------------------
     def healthz(self) -> dict:
@@ -339,6 +422,10 @@ class RouterHTTPHandler(BaseHTTPRequestHandler):
                 )
             else:
                 self._send_json(200, router.metrics())
+        elif parts.path.startswith("/attack/"):
+            # stream poll: probe replicas for the one holding the stream
+            status, headers, resp_body = router.route_poll(self.path)
+            self._send(status, resp_body, headers, "application/json")
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
@@ -350,10 +437,19 @@ class RouterHTTPHandler(BaseHTTPRequestHandler):
             self.close_connection = True
             return
         body = self.rfile.read(length)
-        if self.path != "/attack":
+        parts = urlsplit(self.path)
+        if parts.path != "/attack":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
-        status, headers, resp_body = self.server.router.route(body)
+        # the priority class propagates end-to-end: body-carried classes
+        # ride the body untouched; header-carried ones are forwarded
+        fwd: dict = {}
+        qos_class = self.headers.get("X-Qos-Class")
+        if qos_class:
+            fwd["X-Qos-Class"] = qos_class
+        status, headers, resp_body = self.server.router.route(
+            body, path=self.path, req_headers=fwd
+        )
         self._send(status, resp_body, headers, "application/json")
 
 

@@ -1,0 +1,31 @@
+"""QoS serving layer: priority classes, cost-predictive admission, streams.
+
+Three cooperating pieces (docs/DESIGN.md § QoS):
+
+- :mod:`policy` — the class taxonomy (``interactive``/``batch``/
+  ``scavenger`` by default), per-tenant defaults, and the config loader.
+- :mod:`admission` — per-(domain, class) token buckets sized from the
+  capacity model's ``max_sustainable_qps`` × class rate share; the
+  cost-predictive front door that sheds scavenger/batch first under
+  overload, with ledger-predicted per-class ``Retry-After``.
+- :mod:`stream` — per-request :class:`ResultStream` fed by the MoEvA
+  early-exit gate: solved rows surface to the caller as they park,
+  before the scan completes.
+
+Everything here is host-side bookkeeping: with no :class:`QosPolicy`
+wired into the service the request path is bit-identical and compiles
+nothing extra.
+"""
+
+from .admission import AdmissionController
+from .policy import DEFAULT_CLASSES, QosClass, QosPolicy
+from .stream import ResultStream, StreamRegistry
+
+__all__ = [
+    "AdmissionController",
+    "DEFAULT_CLASSES",
+    "QosClass",
+    "QosPolicy",
+    "ResultStream",
+    "StreamRegistry",
+]

@@ -1,0 +1,188 @@
+"""Cost-predictive admission: per-class token buckets priced by the
+capacity model.
+
+The capacity model (observability/capacity.py) already turns the cost
+ledger's per-executable FLOP totals into a per-domain
+``max_sustainable_qps`` — the rate the device can actually serve at the
+currently-observed predicted FLOPs/request. Admission multiplies that by
+each class's ``rate_share`` and runs a standard token bucket per
+(domain, class): a request costs one token, tokens refill at the class
+rate, and the bucket holds ``rate * burst_s`` tokens of burst. The
+consequences fall out by construction:
+
+- overload sheds the small-share classes (scavenger, then batch) first,
+  because their buckets drain first and refill slowest;
+- a queue-full 429's ``Retry-After`` is *predicted* from the class
+  refill rate (time until one token exists), not a blind constant;
+- while the capacity window is unprimed (no batches observed yet, or
+  the model can't price this domain), everything is admitted — the
+  bucket arms itself from measurement, mirroring the bench-gate
+  "unarmed until first record" discipline.
+
+Pure host-side arithmetic: no compiles, no dispatches, O(1) per request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from .policy import QosPolicy
+
+#: how long one capacity-model read is reused before re-deriving the
+#: per-class rates — domain_block() walks the observation window, so
+#: pricing every request individually would make admission O(window)
+_RATE_CACHE_S = 0.25
+
+#: Retry-After clamp, matching CapacityModel.retry_after_s discipline
+_RETRY_FLOOR_S = 0.001
+_RETRY_CAP_S = 30.0
+
+
+class AdmissionDenied(Exception):
+    """Raised when a class bucket has no token; carries the predicted wait."""
+
+    def __init__(self, klass: str, retry_after_s: float, rate: float):
+        self.klass = klass
+        self.retry_after_s = retry_after_s
+        self.rate = rate
+        super().__init__(
+            f"admission: class {klass!r} over its rate "
+            f"({rate:.3f} rps); retry in {retry_after_s:.3f}s"
+        )
+
+
+class _Bucket:
+    __slots__ = ("tokens", "last_refill", "rate", "burst")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst  # start full: admission never cold-rejects
+        self.last_refill = now
+
+
+class AdmissionController:
+    """Per-(domain, class) token buckets sized from the capacity model."""
+
+    def __init__(
+        self,
+        policy: QosPolicy,
+        capacity: Any,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        burst_s: float | None = None,
+    ):
+        self.policy = policy
+        self.capacity = capacity
+        self.clock = clock
+        self.burst_s = (
+            policy.admission_burst_s if burst_s is None else float(burst_s)
+        )
+        self._lock = threading.Lock()
+        self._buckets: dict[tuple[str, str], _Bucket] = {}
+        self._rates: dict[str, tuple[float, float | None]] = {}  # domain -> (t, qps)
+        self.admitted = 0
+        self.denied = 0
+        self.denied_by_class: dict[str, int] = {}
+
+    # -- rates -------------------------------------------------------------
+
+    def _domain_qps(self, domain: str, now: float) -> float | None:
+        """Cached ``max_sustainable_qps`` read; None = model unprimed."""
+        cached = self._rates.get(domain)
+        if cached is not None and now - cached[0] < _RATE_CACHE_S:
+            return cached[1]
+        qps = None
+        if self.capacity is not None:
+            try:
+                block = self.capacity.domain_block(domain)
+                qps = block.get("max_sustainable_qps") if block else None
+            except Exception:
+                qps = None
+        self._rates[domain] = (now, qps)
+        return qps
+
+    def class_rate(self, domain: str, klass: str) -> float | None:
+        """The refill rate (rps) class ``klass`` currently gets for
+        ``domain``; None while the capacity model can't price it."""
+        qps = self._domain_qps(domain, self.clock())
+        if qps is None or qps <= 0:
+            return None
+        qc = self.policy.classes.get(klass)
+        share = qc.rate_share if qc else 1.0
+        return max(qps * share, 0.0)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, domain: str, klass: str) -> None:
+        """Take one token or raise :class:`AdmissionDenied`.
+
+        Unpriceable (unprimed-capacity) traffic is always admitted; a
+        zero-share class is never admitted once the model is primed.
+        """
+        now = self.clock()
+        with self._lock:
+            rate = self._class_rate_locked(domain, klass, now)
+            if rate is None:
+                self.admitted += 1
+                return
+            key = (domain, klass)
+            burst = max(rate * self.burst_s, 1.0)
+            b = self._buckets.get(key)
+            if b is None:
+                b = _Bucket(rate, burst, now)
+                self._buckets[key] = b
+            else:
+                # re-derive against the live rate: capacity drift resizes
+                # the bucket without dropping accumulated tokens past burst
+                b.tokens = min(
+                    b.tokens + (now - b.last_refill) * b.rate, burst
+                )
+                b.rate, b.burst, b.last_refill = rate, burst, now
+            if b.tokens >= 1.0:
+                b.tokens -= 1.0
+                self.admitted += 1
+                return
+            self.denied += 1
+            self.denied_by_class[klass] = (
+                self.denied_by_class.get(klass, 0) + 1
+            )
+            if rate > 0:
+                wait = (1.0 - b.tokens) / rate
+            else:
+                wait = _RETRY_CAP_S
+            raise AdmissionDenied(
+                klass, min(max(wait, _RETRY_FLOOR_S), _RETRY_CAP_S), rate
+            )
+
+    def _class_rate_locked(
+        self, domain: str, klass: str, now: float
+    ) -> float | None:
+        qps = self._domain_qps(domain, now)
+        if qps is None or qps <= 0:
+            return None
+        qc = self.policy.classes.get(klass)
+        share = qc.rate_share if qc else 1.0
+        return max(qps * share, 0.0)
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": bool(self.policy.admission),
+                "burst_s": self.burst_s,
+                "admitted": self.admitted,
+                "denied": self.denied,
+                "denied_by_class": dict(self.denied_by_class),
+                "buckets": {
+                    f"{d}|{k}": {
+                        "rate_rps": round(b.rate, 6),
+                        "burst": round(b.burst, 3),
+                        "tokens": round(b.tokens, 3),
+                    }
+                    for (d, k), b in self._buckets.items()
+                },
+            }

@@ -1,0 +1,144 @@
+"""Priority-class taxonomy and per-tenant defaults (config-driven).
+
+A :class:`QosClass` is a named priority tier with three numbers:
+
+- ``priority`` — strict-preemption rank (lower = more urgent). At flush
+  time a flushable higher-priority bucket always dispatches before a
+  lower-priority one; within batch assembly the priority order decides
+  who gets the leftover seats after the weighted guarantee.
+- ``weight`` — weighted-fairness share inside one assembled batch: each
+  class present in a queue is guaranteed
+  ``floor(batch_capacity * weight / sum(present weights))`` rows before
+  strict-priority filling takes over, which bounds starvation of low
+  tiers to one guaranteed slice per batch rather than "whenever the
+  high tiers go quiet".
+- ``rate_share`` — fraction of the domain's ``max_sustainable_qps`` the
+  admission controller's token bucket grants this class (shares need
+  not sum to 1; >1 total deliberately oversubscribes).
+
+``p99_slo_ms`` is a target carried into records/benchmarks, not an
+enforcement knob — the QoS bench gate checks interactive p99 against it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class QosClass:
+    name: str
+    #: strict-preemption rank; lower is more urgent (0 = front of line)
+    priority: int
+    #: weighted-fairness share inside one assembled batch
+    weight: float = 1.0
+    #: fraction of max_sustainable_qps this class's admission bucket gets
+    rate_share: float = 1.0
+    #: latency target (ms) carried into records; None = no stated target
+    p99_slo_ms: float | None = None
+
+
+#: the default three-tier taxonomy. ``interactive`` preempts everything
+#: and owns most of the admission rate; ``scavenger`` runs on leftovers
+#: and is by construction the first tier shed under overload.
+DEFAULT_CLASSES: tuple[QosClass, ...] = (
+    QosClass("interactive", priority=0, weight=4.0, rate_share=0.6,
+             p99_slo_ms=None),
+    QosClass("batch", priority=1, weight=2.0, rate_share=0.3),
+    QosClass("scavenger", priority=2, weight=1.0, rate_share=0.1),
+)
+
+
+@dataclass
+class QosPolicy:
+    """The resolved QoS configuration a service instance runs under."""
+
+    classes: dict[str, QosClass] = field(
+        default_factory=lambda: {c.name: c for c in DEFAULT_CLASSES}
+    )
+    #: class assigned when a request names neither a class nor a tenant
+    default_class: str = "batch"
+    #: tenant name -> class name (per-tenant defaults from serving.yaml)
+    tenants: dict[str, str] = field(default_factory=dict)
+    #: cost-predictive admission on/off (off = queue-depth 429s only)
+    admission: bool = True
+    #: admission token-bucket burst horizon in seconds of class rate
+    admission_burst_s: float = 2.0
+    #: streaming partial results on/off (off = /attack?stream=1 is a 400)
+    streaming: bool = True
+
+    def __post_init__(self) -> None:
+        if self.default_class not in self.classes:
+            raise ValueError(
+                f"default_class {self.default_class!r} is not a configured "
+                f"class (have: {sorted(self.classes)})"
+            )
+        for tenant, klass in self.tenants.items():
+            if klass not in self.classes:
+                raise ValueError(
+                    f"tenant {tenant!r} maps to unknown class {klass!r}"
+                )
+
+    @classmethod
+    def from_config(cls, cfg: dict | None) -> "QosPolicy | None":
+        """Build a policy from the ``serving.qos`` config block.
+
+        ``None``/missing block or ``enabled: false`` -> ``None`` (QoS
+        fully off: the service runs the exact pre-QoS request path).
+        Class entries override/extend the default taxonomy field-wise.
+        """
+        if not cfg or not cfg.get("enabled", True):
+            return None
+        classes = {c.name: c for c in DEFAULT_CLASSES}
+        for name, spec in (cfg.get("classes") or {}).items():
+            spec = dict(spec or {})
+            base = classes.get(name)
+            classes[name] = QosClass(
+                name=name,
+                priority=int(
+                    spec.get("priority", base.priority if base else 99)
+                ),
+                weight=float(spec.get("weight", base.weight if base else 1.0)),
+                rate_share=float(
+                    spec.get("rate_share", base.rate_share if base else 1.0)
+                ),
+                p99_slo_ms=(
+                    float(spec["p99_slo_ms"])
+                    if spec.get("p99_slo_ms") is not None
+                    else (base.p99_slo_ms if base else None)
+                ),
+            )
+        admission_cfg = cfg.get("admission") or {}
+        streaming_cfg = cfg.get("streaming") or {}
+        return cls(
+            classes=classes,
+            default_class=str(cfg.get("default_class", "batch")),
+            tenants={
+                str(t): str(k) for t, k in (cfg.get("tenants") or {}).items()
+            },
+            admission=bool(admission_cfg.get("enabled", True)),
+            admission_burst_s=float(admission_cfg.get("burst_s", 2.0)),
+            streaming=bool(streaming_cfg.get("enabled", True)),
+        )
+
+    def resolve(
+        self, name: str | None = None, tenant: str | None = None
+    ) -> QosClass:
+        """Resolve a request's class: explicit name > tenant default >
+        policy default. Unknown names fall back to the default class —
+        a typo'd priority must degrade service, not reject the request."""
+        if name and name in self.classes:
+            return self.classes[name]
+        if tenant and tenant in self.tenants:
+            return self.classes[self.tenants[tenant]]
+        return self.classes[self.default_class]
+
+    def priority_of(self, name: str | None) -> int:
+        klass = self.classes.get(name) if name else None
+        return klass.priority if klass else self.classes[
+            self.default_class
+        ].priority
+
+    def ordered(self) -> list[QosClass]:
+        """Classes in strict-priority order (most urgent first)."""
+        return sorted(self.classes.values(), key=lambda c: (c.priority, c.name))

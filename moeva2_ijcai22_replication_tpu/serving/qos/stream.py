@@ -1,0 +1,210 @@
+"""Per-request result streams fed by the MoEvA early-exit gate.
+
+The engine already identifies solved states mid-scan (solved-state
+parking) and fetches their populations on a double-buffered gate tail —
+but until now those rows sat in host arrays until the whole scan
+finished. A :class:`ResultStream` is the bridge: the batcher routes the
+engine's partial sink to each rider's stream, solved rows are decoded
+and surfaced *as they park*, and the caller consumes them either as
+chunked HTTP (``/attack?stream=1``) or by incremental poll
+(``GET /attack/<id>?cursor=N``).
+
+Semantics the consumer can rely on:
+
+- Chunks arrive in gate order; within one request each row index
+  appears at most once before the final chunk (a row parks once).
+- ``time_to_first_solved_s`` is stamped at the first partial chunk —
+  the streaming headline number, recorded next to
+  ``time_to_complete_s``.
+- The final payload always carries the COMPLETE result (every row,
+  solved or not), so a consumer that ignores partials loses nothing.
+- MoEvA RNG caveat (docs/DESIGN.md § QoS): a partial row's payload is
+  the solved population at its park generation; the final result's
+  same row comes from the identical parked buffer, so partial and
+  final rows agree — but across *different batch shapes* MoEvA results
+  are not bit-identical (compaction reshuffles the PRNG), and partial
+  streams inherit exactly that caveat, no more.
+
+Thread model: one producer (the batcher's dispatch thread), any number
+of consumers. All state sits behind one condition variable; `put` after
+`finish`/`fail` is dropped (late gate flush of an already-failed batch).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+
+class ResultStream:
+    """One request's ordered sequence of partial chunks + final result."""
+
+    def __init__(
+        self,
+        request_id: str,
+        n_rows: int,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.request_id = request_id
+        self.n_rows = int(n_rows)
+        self.clock = clock
+        self.created_at = clock()
+        self._cond = threading.Condition()
+        self._chunks: list[dict] = []
+        self._done = False
+        self._error: BaseException | None = None
+        self._final: dict | None = None
+        self._closed_by_consumer = False
+        self.t_first_solved: float | None = None
+        self.t_finished: float | None = None
+        self.rows_streamed = 0
+
+    # -- producer ----------------------------------------------------------
+
+    def put(self, rows: list[int], x_rows: Any, gen: int) -> None:
+        """Append one partial chunk: request-local ``rows`` solved at
+        generation ``gen`` with decoded ML-space payload ``x_rows``."""
+        with self._cond:
+            if self._done or self._closed_by_consumer:
+                return
+            if self.t_first_solved is None:
+                self.t_first_solved = self.clock()
+            self.rows_streamed += len(rows)
+            self._chunks.append(
+                {
+                    "rows": [int(r) for r in rows],
+                    "x": x_rows,
+                    "gen": int(gen),
+                    "t": self.clock(),
+                }
+            )
+            self._cond.notify_all()
+
+    def finish(self, x_adv: Any, meta: dict | None = None) -> None:
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self.t_finished = self.clock()
+            self._final = {"x_adv": x_adv, "meta": meta or {}}
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self.t_finished = self.clock()
+            self._error = exc
+            self._cond.notify_all()
+
+    # -- consumer ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Consumer walked away (chunked connection dropped): further
+        partials are discarded, the producer is never blocked or failed."""
+        with self._cond:
+            self._closed_by_consumer = True
+            self._chunks.clear()
+            self._cond.notify_all()
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    @property
+    def error(self) -> BaseException | None:
+        with self._cond:
+            return self._error
+
+    @property
+    def final(self) -> dict | None:
+        with self._cond:
+            return self._final
+
+    def chunks(self, timeout: float | None = None) -> Iterator[dict]:
+        """Blocking iterator over partial chunks, ending when the stream
+        finishes or fails (the final payload is NOT yielded — read
+        :attr:`final`/:attr:`error` after). Raises ``TimeoutError`` if
+        no progress happens within ``timeout`` seconds."""
+        cursor = 0
+        while True:
+            with self._cond:
+                while cursor >= len(self._chunks) and not self._done:
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError(
+                            f"stream {self.request_id}: no progress in "
+                            f"{timeout}s"
+                        )
+                batch = self._chunks[cursor:]
+                cursor = len(self._chunks)
+                done = self._done
+            for chunk in batch:
+                yield chunk
+            if done and cursor >= self._chunk_count():
+                return
+
+    def _chunk_count(self) -> int:
+        with self._cond:
+            return len(self._chunks)
+
+    def poll(self, cursor: int = 0) -> dict:
+        """Non-blocking incremental read from ``cursor`` (chunk index)."""
+        with self._cond:
+            chunks = self._chunks[cursor:]
+            return {
+                "request_id": self.request_id,
+                "cursor": len(self._chunks),
+                "chunks": chunks,
+                "done": self._done,
+                "failed": self._error is not None,
+                "rows_streamed": self.rows_streamed,
+                "n_rows": self.n_rows,
+            }
+
+
+class StreamRegistry:
+    """Bounded request_id -> stream map behind the poll endpoints.
+
+    Finished streams are retained (so a poller can still collect the
+    final payload) until capacity pressure evicts the oldest finished
+    entries; live streams are never evicted.
+    """
+
+    def __init__(self, max_entries: int = 512):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._streams: dict[str, ResultStream] = {}
+        self.evicted = 0
+
+    def add(self, stream: ResultStream) -> None:
+        with self._lock:
+            self._streams[stream.request_id] = stream
+            if len(self._streams) > self.max_entries:
+                finished = [
+                    rid
+                    for rid, s in self._streams.items()
+                    if s.done and rid != stream.request_id
+                ]
+                # insertion order == age: evict oldest finished first
+                for rid in finished[
+                    : len(self._streams) - self.max_entries
+                ]:
+                    del self._streams[rid]
+                    self.evicted += 1
+
+    def get(self, request_id: str) -> ResultStream | None:
+        with self._lock:
+            return self._streams.get(request_id)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            live = sum(1 for s in self._streams.values() if not s.done)
+            return {
+                "entries": len(self._streams),
+                "live": live,
+                "evicted": self.evicted,
+            }

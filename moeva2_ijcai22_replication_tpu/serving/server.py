@@ -4,11 +4,22 @@ Three routes, no dependencies beyond ``http.server``:
 
 - ``POST /attack`` — body ``{"domain", "rows": [[...]], "attack",
   "loss_evaluation", "eps", "eps_step", "budget", "deadline_s",
-  "request_id", "params"}``; replies ``{"request_id", "x_adv", "meta"}``.
+  "request_id", "params", "priority", "tenant"}``; replies
+  ``{"request_id", "x_adv", "meta"}``. ``priority`` names a QoS class
+  (``X-Qos-Class`` header is the fallback when the body omits it); the
+  resolved class echoes back as an ``X-Qos-Class`` response header on
+  every reply, including errors — the fleet router propagates both ways.
   Error mapping: 400 invalid request / unparseable body, 413 request larger
-  than the biggest bucket, 429 + ``Retry-After`` on backpressure, 504 on a
-  queued deadline or server-side wait timeout, 500 when the request's batch
-  failed.
+  than the biggest bucket, 429 + ``Retry-After`` on backpressure (queue
+  full OR cost-predictive admission denial), 504 on a queued deadline or
+  server-side wait timeout, 500 when the request's batch failed.
+- ``POST /attack?stream=1`` — same body; replies chunked JSON-lines
+  (``application/x-ndjson``): one record per partial chunk as the MoEvA
+  early-exit gate parks solved rows, then a final ``{"done": true,
+  "request_id", "x_adv", "meta"}`` record carrying the complete result.
+  ``POST /attack?stream=poll`` instead replies 202 with the request id;
+  ``GET /attack/<id>?cursor=N`` then reads chunks incrementally.
+  Requires ``serving.qos.streaming`` (400 otherwise).
 - ``GET /healthz`` — liveness + queue depth + build/config identity (git
   describe, config hash, per-domain mesh description) so load balancers can
   detect a mis-deployed or mis-meshed replica.
@@ -69,6 +80,12 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _write_chunk(self, obj: dict):
+        """One HTTP/1.1 chunked-transfer frame holding one JSON line."""
+        data = (json.dumps(_jsonable(obj)) + "\n").encode()
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
     def _send_text(self, code: int, body: str, content_type: str):
         data = body.encode()
         self.send_response(code)
@@ -97,6 +114,36 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
                 )
             else:
                 self._send(200, service.metrics_snapshot())
+        elif parts.path.startswith("/attack/"):
+            # incremental poll of a streaming request submitted with
+            # ?stream=poll (or any request whose stream is still retained)
+            rid = parts.path[len("/attack/") :]
+            streams = getattr(service, "streams", None)
+            if streams is None:
+                self._send(
+                    400,
+                    {"error": "streaming is not enabled (serving.qos.streaming)"},
+                )
+                return
+            stream = streams.get(rid)
+            if stream is None:
+                self._send(404, {"error": f"unknown or evicted stream {rid!r}"})
+                return
+            try:
+                cursor = int(parse_qs(parts.query).get("cursor", ["0"])[0])
+            except ValueError:
+                self._send(400, {"error": "bad cursor (want an integer)"})
+                return
+            out = stream.poll(cursor)
+            if out["done"]:
+                err = stream.error
+                if err is not None:
+                    out["error"] = str(err)
+                else:
+                    final = stream.final
+                    out["x_adv"] = final["x_adv"]
+                    out["meta"] = final["meta"]
+            self._send(200, out)
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -110,7 +157,8 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
             self.close_connection = True
             return
         body = self.rfile.read(length)
-        if self.path != "/attack":
+        parts = urlsplit(self.path)
+        if parts.path != "/attack":
             self._send(404, {"error": f"no route {self.path}"})
             return
         service = self.server.service
@@ -127,28 +175,49 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
                 deadline_s=payload.get("deadline_s"),
                 request_id=payload.get("request_id"),
                 params=payload.get("params"),
+                # body wins; the header is how the fleet router (and any
+                # proxy that can't rewrite bodies) forwards the class
+                priority=payload.get("priority")
+                or self.headers.get("X-Qos-Class"),
+                tenant=payload.get("tenant"),
             )
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._send(400, {"error": f"bad request body: {e!r}"})
             return
+        qos_hdrs: dict = {}
+        if getattr(service, "qos", None) is not None:
+            qos_hdrs["X-Qos-Class"] = service.qos.resolve(
+                req.priority, req.tenant
+            ).name
+        stream_mode = parse_qs(parts.query).get("stream", [""])[0]
+        if stream_mode:
+            self._attack_streaming(service, req, stream_mode, qos_hdrs)
+            return
         try:
             resp = service.attack(req, timeout=self.server.request_timeout_s)
         except InvalidRequest as e:
-            self._send(400, {"error": str(e)})
+            self._send(400, {"error": str(e)}, headers=qos_hdrs)
         except RequestTooLarge as e:
-            self._send(413, {"error": str(e)})
+            self._send(413, {"error": str(e)}, headers=qos_hdrs)
         except QueueFull as e:
             self._send(
                 429,
                 {"error": str(e), "retry_after_s": e.retry_after_s},
-                headers={"Retry-After": f"{max(e.retry_after_s, 0.001):.3f}"},
+                headers={
+                    "Retry-After": f"{max(e.retry_after_s, 0.001):.3f}",
+                    **qos_hdrs,
+                },
             )
         except DeadlineExceeded as e:
-            self._send(504, {"error": str(e)})
+            self._send(504, {"error": str(e)}, headers=qos_hdrs)
         except (TimeoutError, FuturesTimeout) as e:  # result(timeout=) expired
-            self._send(504, {"error": f"server-side wait timed out: {e!r}"})
+            self._send(
+                504,
+                {"error": f"server-side wait timed out: {e!r}"},
+                headers=qos_hdrs,
+            )
         except BatchExecutionError as e:
-            self._send(500, {"error": str(e)})
+            self._send(500, {"error": str(e)}, headers=qos_hdrs)
         else:
             self._send(
                 200,
@@ -157,7 +226,101 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
                     "x_adv": resp.x_adv,
                     "meta": resp.meta,
                 },
+                headers=qos_hdrs,
             )
+
+    def _attack_streaming(self, service, req, mode: str, qos_hdrs: dict):
+        """``stream=poll`` -> 202 + request id (read via GET
+        ``/attack/<id>?cursor=N``); anything else (``stream=1``) -> chunked
+        JSON-lines: partial records as rows park, then the final
+        ``{"done": true}`` record. Submission errors map exactly like the
+        blocking route; errors AFTER the 200 header is on the wire ride the
+        final record instead (chunked transfer can't change the status)."""
+        try:
+            stream, fut = service.submit_stream(req)
+        except InvalidRequest as e:
+            self._send(400, {"error": str(e)}, headers=qos_hdrs)
+            return
+        except RequestTooLarge as e:
+            self._send(413, {"error": str(e)}, headers=qos_hdrs)
+            return
+        except QueueFull as e:
+            self._send(
+                429,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                headers={
+                    "Retry-After": f"{max(e.retry_after_s, 0.001):.3f}",
+                    **qos_hdrs,
+                },
+            )
+            return
+        if mode == "poll":
+            self._send(
+                202,
+                {
+                    "request_id": stream.request_id,
+                    "poll": f"/attack/{stream.request_id}",
+                    "n_rows": stream.n_rows,
+                },
+                headers=qos_hdrs,
+            )
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        replica = getattr(service, "replica_id", None)
+        if replica:
+            self.send_header("X-Replica-Id", replica)
+        for k, v in qos_hdrs.items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            try:
+                for chunk in stream.chunks(
+                    timeout=self.server.request_timeout_s
+                ):
+                    self._write_chunk(
+                        {
+                            "request_id": stream.request_id,
+                            "rows": chunk["rows"],
+                            "x": chunk["x"],
+                            "gen": chunk["gen"],
+                        }
+                    )
+            except TimeoutError:
+                self._write_chunk(
+                    {
+                        "done": True,
+                        "request_id": stream.request_id,
+                        "error": "server-side wait timed out",
+                    }
+                )
+            else:
+                err = stream.error
+                if err is not None:
+                    self._write_chunk(
+                        {
+                            "done": True,
+                            "request_id": stream.request_id,
+                            "error": str(err),
+                        }
+                    )
+                else:
+                    final = stream.final
+                    self._write_chunk(
+                        {
+                            "done": True,
+                            "request_id": stream.request_id,
+                            "x_adv": final["x_adv"],
+                            "meta": final["meta"],
+                        }
+                    )
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # consumer walked away mid-stream: discard partials, never
+            # block or fail the producer side
+            stream.close()
+            self.close_connection = True
 
 
 class AttackHTTPServer(ThreadingHTTPServer):

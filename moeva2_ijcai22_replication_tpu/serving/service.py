@@ -62,6 +62,8 @@ from ..observability import (
 from ..utils.config import get_dict_hash
 from ..utils.observability import ServiceMetrics
 from .batcher import BucketMenu, Microbatcher, QueueFull, RequestTooLarge
+from .qos import AdmissionController, ResultStream, StreamRegistry
+from .qos.admission import AdmissionDenied
 
 
 class InvalidRequest(ValueError):
@@ -131,6 +133,12 @@ class AttackRequest:
     deadline_s: float | None = None  # relative; cancelled-if-exceeded pre-dispatch
     request_id: str | None = None
     params: dict | None = None  # extra engine config (moeva n_pop, nb_random, …)
+    #: QoS class name (interactive/batch/scavenger by default); None =
+    #: resolve via tenant default, then the policy default. Ignored (and
+    #: harmless) when the service runs without a QoS policy.
+    priority: str | None = None
+    #: tenant label: picks the per-tenant default class from serving.yaml
+    tenant: str | None = None
 
 
 @dataclass
@@ -213,6 +221,7 @@ class AttackService:
         clock: Callable[[], float] | None = None,
         start: bool = True,
         replica_id: str | None = None,
+        qos=None,
     ):
         self.domains = dict(domains)
         self.seed = int(seed)
@@ -259,6 +268,21 @@ class AttackService:
         # completion timestamps and run_s durations must share one clock
         # domain or the utilization span mixes bases under a fake clock
         self.capacity = CapacityModel(window=capacity_window, clock=self.clock)
+        # QoS layer (serving.qos): None = the exact pre-QoS request path
+        # (no class lanes, no admission, no streams — bit-identical, zero
+        # extra compiles/dispatches by construction). With a QosPolicy,
+        # the batcher grows class lanes, admission prices each request
+        # from the capacity model before enqueue, and MoEvA requests can
+        # stream solved rows as they park.
+        self.qos = qos
+        self.admission = (
+            AdmissionController(qos, self.capacity, clock=self.clock)
+            if qos is not None and qos.admission
+            else None
+        )
+        self.streams = (
+            StreamRegistry() if qos is not None and qos.streaming else None
+        )
         self.batcher = Microbatcher(
             self.menu,
             max_delay_s=max_delay_s,
@@ -270,6 +294,7 @@ class AttackService:
             # honest 429 Retry-After: predicted drain time of the queued
             # rows at the capacity window's sustainable row rate
             retry_after_fn=self.capacity.retry_after_s,
+            qos=qos,
         )
         self._resolved: dict[tuple, _Resolved] = {}
         #: boot-time warmup report (None until :meth:`prewarm` ran)
@@ -486,6 +511,14 @@ class AttackService:
                 # the engine's gate progress events (generation index,
                 # success fraction, active set, HBM) land in the batch trace
                 engine.trace = bt
+                # streaming partial results: the microbatcher put a
+                # partial router in the ambient context iff some rider of
+                # THIS batch streams — the engine then surfaces solved
+                # rows at each gate flush. No router (the common case) =
+                # sink stays None = the engine's gate tail is unchanged.
+                engine.partial_sink = current_ledger_context().get(
+                    "partial_router"
+                )
                 # trace spans on perf_counter, SLO/capacity on the
                 # injectable self.clock (see the pgd closure)
                 t0 = time.perf_counter()
@@ -494,6 +527,7 @@ class AttackService:
                     result = engine.generate(x_batch, 1)
                 finally:
                     engine.trace = None
+                    engine.partial_sink = None
                 traced = engine.trace_count - traces0
                 dur = self.clock() - t0c
                 self.metrics.count("compiles", traced)
@@ -545,6 +579,7 @@ class AttackService:
                 engine.record_quality = False
                 engine.quality_every = 0
                 engine.trace = None
+                engine.partial_sink = None
                 engine.generate(x_batch, 1)
 
             chunk = engine.effective_states_chunk()
@@ -702,6 +737,7 @@ class AttackService:
             rows=int(ctx.get("batch_rows", rows)),
             run_s=dur,
             flops=get_ledger().flops_for(executables) if executables else None,
+            qos_classes=ctx.get("batch_classes"),
         )
 
     def _validate(self, req: AttackRequest, res: _Resolved) -> np.ndarray:
@@ -718,14 +754,24 @@ class AttackService:
         return x
 
     # -- request path --------------------------------------------------------
-    def submit(self, req: AttackRequest):
+    def submit(self, req: AttackRequest, on_partial: Callable | None = None):
         """Validate + enqueue; returns a Future of ``(x_adv, meta)``.
 
         Raises :class:`InvalidRequest` / :class:`~.batcher.QueueFull` /
         :class:`~.batcher.RequestTooLarge` synchronously; queued failures
         (deadline, batch errors) surface through the future.
+        ``on_partial`` (streaming) receives ``(local_rows, x_rows, gen)``
+        as this request's solved rows surface mid-dispatch — wired by
+        :meth:`submit_stream`, which owns the stream bookkeeping.
         """
         rid = req.request_id or uuid.uuid4().hex[:12]
+        # class resolution is a dict lookup — do it before validate so
+        # every shed path (invalid included) carries the class label
+        qos_class = (
+            self.qos.resolve(req.priority, req.tenant).name
+            if self.qos is not None
+            else None
+        )
         # request-scoped trace (None when spans are off — the whole request
         # path then does no trace work at all, the overhead contract)
         # replica-labelled trace ids: a fleet's merged trace streams stay
@@ -759,9 +805,27 @@ class AttackService:
             domain = (
                 req.domain if req.domain in self.domains else "(unknown)"
             )
-            self.slo.shed(domain, "invalid", "validate")
+            self.slo.shed(domain, "invalid", "validate", qos_class=qos_class)
             raise
-        self.slo.observe(req.domain, "validate", self.clock() - t_val)
+        self.slo.observe(
+            req.domain, "validate", self.clock() - t_val, qos_class=qos_class
+        )
+        if self.admission is not None:
+            # cost-predictive admission: one token from the (domain,
+            # class) bucket, priced from the capacity model. A denial is
+            # a 429 whose Retry-After is the class's predicted token
+            # refill time — honest per-class backpressure, shed BEFORE
+            # the request holds any queue slot.
+            try:
+                self.admission.admit(req.domain, qos_class)
+            except AdmissionDenied as exc:
+                self.metrics.count("admission_rejected")
+                self.slo.shed(
+                    req.domain, "rejected", "queue_wait", qos_class=qos_class
+                )
+                raise QueueFull(
+                    str(exc), retry_after_s=exc.retry_after_s
+                ) from exc
         t_submit = self.clock()
         try:
             fut = self.batcher.submit(
@@ -777,14 +841,20 @@ class AttackService:
                     execution=res.execution,
                 ),
                 trace=trace,
+                qos_class=qos_class,
+                on_partial=on_partial,
             )
         except QueueFull:
             # shed attribution: backpressure consumed the request at the
             # queue boundary — it never held a slot
-            self.slo.shed(req.domain, "rejected", "queue_wait")
+            self.slo.shed(
+                req.domain, "rejected", "queue_wait", qos_class=qos_class
+            )
             raise
         except RequestTooLarge:
-            self.slo.shed(req.domain, "too_large", "validate")
+            self.slo.shed(
+                req.domain, "too_large", "validate", qos_class=qos_class
+            )
             raise
 
         def _done(f):
@@ -823,6 +893,53 @@ class AttackService:
         return AttackResponse(
             request_id=meta["request_id"], x_adv=x_adv, meta=meta
         )
+
+    def submit_stream(self, req: AttackRequest):
+        """Streaming request path: returns ``(ResultStream, Future)``.
+
+        The stream surfaces this request's solved rows as the MoEvA
+        early-exit gate parks them (chunked HTTP / incremental poll);
+        the future resolves to the complete ``(x_adv, meta)`` exactly
+        like :meth:`submit`. The final meta carries the streaming
+        headline pair: ``time_to_first_solved_s`` (first partial chunk)
+        next to ``time_to_complete_s``. A PGD request streams trivially
+        (no gate -> no partials, the final result is the first chunk of
+        truth); the same holds for a MoEvA request with early exit off.
+        """
+        if self.streams is None:
+            raise InvalidRequest(
+                "streaming is not enabled (serving.qos.streaming)"
+            )
+        rid = req.request_id or uuid.uuid4().hex[:12]
+        req.request_id = rid
+        n_rows = int(np.asarray(req.x).shape[0])
+        stream = ResultStream(rid, n_rows, clock=self.clock)
+        self.streams.add(stream)
+        t_submit = self.clock()
+        try:
+            fut = self.submit(req, on_partial=stream.put)
+        except BaseException as exc:
+            stream.fail(exc)
+            raise
+
+        def _finish(f):
+            exc = f.exception()
+            if exc is not None:
+                stream.fail(exc)
+                return
+            x_adv, meta = f.result()
+            ttc = self.clock() - t_submit
+            meta["time_to_complete_s"] = round(ttc, 6)
+            meta["rows_streamed"] = stream.rows_streamed
+            if stream.t_first_solved is not None:
+                ttfs = stream.t_first_solved - t_submit
+                meta["time_to_first_solved_s"] = round(ttfs, 6)
+                self.metrics.observe("time_to_first_solved_s", ttfs)
+            self.metrics.observe("time_to_complete_s", ttc)
+            stream.finish(x_adv, meta)
+
+        fut.add_done_callback(_finish)
+        return stream, fut
 
     def execute_direct(
         self, req: AttackRequest, bucket: int | None = None
@@ -977,6 +1094,9 @@ class AttackService:
                 "enabled": self.slo.enabled,
                 "shed": self.slo.shed_block(),
             },
+            # QoS layer state (None when no policy is wired): the class
+            # taxonomy, per-class admission buckets, live stream count
+            "qos": self.qos_snapshot(),
             "caches": {
                 "engine": dict(
                     common.ENGINES.stats(),
@@ -994,6 +1114,34 @@ class AttackService:
     #: most-recent recompile causes surfaced on /healthz (full, bounded
     #: lists stay on the caches/ledger themselves)
     RECOMPILE_CAUSES_SHOWN = 8
+
+    def qos_snapshot(self) -> dict | None:
+        """The QoS layer's introspection block (None = QoS off)."""
+        if self.qos is None:
+            return None
+        return {
+            "classes": {
+                c.name: {
+                    "priority": c.priority,
+                    "weight": c.weight,
+                    "rate_share": c.rate_share,
+                    "p99_slo_ms": c.p99_slo_ms,
+                }
+                for c in self.qos.ordered()
+            },
+            "default_class": self.qos.default_class,
+            "tenants": dict(self.qos.tenants),
+            "admission": (
+                self.admission.snapshot()
+                if self.admission is not None
+                else {"enabled": False}
+            ),
+            "streams": (
+                self.streams.snapshot()
+                if self.streams is not None
+                else {"enabled": False}
+            ),
+        }
 
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
@@ -1029,6 +1177,8 @@ class AttackService:
             spans=spans_from_recorder(self.recorder)
         )
         snap["coldstart"] = get_coldstart().cold_block()
+        if self.qos is not None:
+            snap["qos"] = self.qos_snapshot()
         return snap
 
     def close(self):

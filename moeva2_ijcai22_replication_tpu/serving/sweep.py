@@ -59,28 +59,50 @@ def run_level(
     occupancies: list[float] = []
     rows_done = 0
     rejected = timeouts = failed = 0
-    in_flight: list[tuple[float, dict, object]] = []
+    in_flight: list[tuple[float, dict, object, str | None]] = []
+    # per-QoS-class mirror of the level counters, keyed by the RESOLVED
+    # class (what the service accounted, not the raw request field);
+    # stays empty — and off the record — for a classless sweep
+    per_class: dict[str, dict] = {}
+
+    def slot(klass: str | None) -> dict | None:
+        if klass is None:
+            return None
+        return per_class.setdefault(
+            klass,
+            {"completed": 0, "rejected": 0, "deadline_timeouts": 0,
+             "failed": 0, "latencies": []},
+        )
 
     def reap(block: bool):
         nonlocal rows_done, timeouts, failed
         remaining = []
-        for t_sub, stamp, fut in in_flight:
+        for t_sub, stamp, fut, klass in in_flight:
             if not block and not fut.done():
-                remaining.append((t_sub, stamp, fut))
+                remaining.append((t_sub, stamp, fut, klass))
                 continue
+            c = slot(klass)
             try:
                 x_adv, meta = fut.result(timeout=timeout_s)
             except Exception as e:  # noqa: BLE001 — bench counts, not raises
                 if isinstance(e, DeadlineExceeded):
                     timeouts += 1
+                    if c is not None:
+                        c["deadline_timeouts"] += 1
                 else:
                     failed += 1
+                    if c is not None:
+                        c["failed"] += 1
                 continue
             # completion was stamped by the done-callback, so lazy reaping
             # cannot inflate the measured latency
-            latencies.append(stamp.get("t_done", clock()) - t_sub)
+            lat = stamp.get("t_done", clock()) - t_sub
+            latencies.append(lat)
             occupancies.append(meta["batch_occupancy"])
             rows_done += int(meta["rows"])
+            if c is not None:
+                c["completed"] += 1
+                c["latencies"].append(lat)
         in_flight[:] = remaining
 
     offsets = arrival_offsets(arrival, offered_rps, n_requests, seed)
@@ -101,16 +123,28 @@ def run_level(
         # schedule to avoid). Unpaced (rate 0) has no schedule: measure
         # from submit, like loadgen's unpaced throughput-probe mode.
         t_sub = target if offered_rps > 0 else clock()
+        req = make_request(i)
+        # getattr: the SLO tests drive the sweep with minimal fake
+        # services that predate the qos attribute
+        qos = getattr(service, "qos", None)
+        klass = (
+            qos.resolve(req.priority, req.tenant).name
+            if qos is not None
+            else None
+        )
         try:
-            fut = service.submit(make_request(i))
+            fut = service.submit(req)
         except (QueueFull, RequestTooLarge):
             rejected += 1
+            c = slot(klass)
+            if c is not None:
+                c["rejected"] += 1
             continue
         stamp: dict = {}
         fut.add_done_callback(
             lambda f, s=stamp: s.__setitem__("t_done", clock())
         )
-        in_flight.append((t_sub, stamp, fut))
+        in_flight.append((t_sub, stamp, fut, klass))
         if len(in_flight) % 64 == 0:
             reap(block=False)
     reap(block=True)
@@ -152,6 +186,35 @@ def run_level(
         )
         if occupancies
         else None,
+        # per-resolved-class view of the same level (QoS sweeps only):
+        # the bench evidence that interactive held its SLO while the
+        # low classes absorbed the overload
+        **(
+            {
+                "by_class": {
+                    k: {
+                        "completed": c["completed"],
+                        "rejected": c["rejected"],
+                        "deadline_timeouts": c["deadline_timeouts"],
+                        "failed": c["failed"],
+                        "p50_ms": round(
+                            percentile(sorted(c["latencies"]), 0.50) * 1e3, 2
+                        )
+                        if c["latencies"]
+                        else None,
+                        "p99_ms": round(
+                            percentile(sorted(c["latencies"]), 0.99) * 1e3, 2
+                        )
+                        if c["latencies"]
+                        else None,
+                        "quantiles_n": len(c["latencies"]),
+                    }
+                    for k, c in sorted(per_class.items())
+                }
+            }
+            if per_class
+            else {}
+        ),
     }
 
 

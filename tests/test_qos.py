@@ -1,0 +1,610 @@
+"""QoS layer tests: class lanes, admission pricing, streaming, shed labels.
+
+Everything above the service smoke runs hardware-free on fake clocks:
+weighted-fair batch assembly + the starvation bound, strict-priority
+preemption at flush, the admission token-bucket arithmetic against a fake
+capacity model, ResultStream ordering/early-close and the batcher's
+partial-row router, the per-class shed attribution matrix, and the
+deadline-attribution regression (expiry after assembly reached a request
+must shed as ``batch_wait``, not ``queue_wait``). The final smoke drives
+real PGD requests through two services — QoS off vs. on — and pins the
+off-switch contract: bit-identical results, zero extra compiles, equal
+dispatch counts.
+"""
+
+import numpy as np
+import pytest
+
+from moeva2_ijcai22_replication_tpu.domains.lcld import LcldConstraints
+from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
+from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
+from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
+from moeva2_ijcai22_replication_tpu.observability import (
+    current_ledger_context,
+    get_ledger,
+)
+from moeva2_ijcai22_replication_tpu.observability.slo import SloTracker
+from moeva2_ijcai22_replication_tpu.serving import (
+    AttackRequest,
+    AttackService,
+    BucketMenu,
+    DeadlineExceeded,
+    Microbatcher,
+    QosClass,
+    QosPolicy,
+    ResultStream,
+)
+from moeva2_ijcai22_replication_tpu.serving.qos.admission import (
+    AdmissionController,
+    AdmissionDenied,
+)
+from moeva2_ijcai22_replication_tpu.utils.observability import ServiceMetrics
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def three_tier_policy(**kw):
+    """The bench taxonomy: interactive(w4) > batch(w2) > scavenger(w1)."""
+    return QosPolicy(
+        classes={
+            "interactive": QosClass(
+                "interactive", priority=0, weight=4.0, rate_share=0.6
+            ),
+            "batch": QosClass("batch", priority=1, weight=2.0, rate_share=0.3),
+            "scavenger": QosClass(
+                "scavenger", priority=2, weight=1.0, rate_share=0.1
+            ),
+        },
+        default_class="batch",
+        **kw,
+    )
+
+
+def make_batcher(
+    sizes=(8,), qos=None, slo=None, max_delay_s=0.01, clock=None
+):
+    clock = clock or FakeClock()
+    b = Microbatcher(
+        BucketMenu(sizes),
+        max_delay_s=max_delay_s,
+        max_queue_rows=256,
+        metrics=ServiceMetrics(),
+        slo=slo,
+        clock=clock,
+        start=False,
+        qos=qos,
+    )
+    return b, clock
+
+
+def class_counts(x):
+    """Row values encode the class a request was submitted under
+    (0=interactive, 1=batch, 2=scavenger); padding rows are 0-valued
+    only past the real batch, so callers slice to rows_total first."""
+    vals, counts = np.unique(x[:, 0].astype(int), return_counts=True)
+    return dict(zip(vals.tolist(), counts.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair assembly + starvation bound
+# ---------------------------------------------------------------------------
+
+
+class TestWeightedFairness:
+    def test_seats_then_priority_fill(self):
+        """Capacity 8, weights 4/2/1 all present: guaranteed seats are
+        floor(8*w/7) = 4/2/1, the one leftover seat goes to the highest
+        priority class — so a backlog of 8 interactive rows still cannot
+        push queued batch/scavenger work out of the first batch."""
+        b, _ = make_batcher(qos=three_tier_policy())
+        captured = []
+        disp = lambda x: captured.append(x.copy()) or x  # noqa: E731
+        for _ in range(8):
+            b.submit("k", disp, np.zeros((1, 1)), qos_class="interactive")
+        for _ in range(4):
+            b.submit("k", disp, np.ones((1, 1)), qos_class="batch")
+        for _ in range(4):
+            b.submit("k", disp, np.full((1, 1), 2.0), qos_class="scavenger")
+
+        assert b.flush_due() == 1  # capacity flush, no deadline wait
+        assert class_counts(captured[0][:8]) == {0: 5, 1: 2, 2: 1}
+
+    def test_starvation_bound_every_batch_carries_scavenger(self):
+        """Scavenger work is guaranteed its slice of EVERY batch its key
+        flushes while it has queued rows — not just 'eventually'."""
+        b, clock = make_batcher(qos=three_tier_policy())
+        captured = []
+        disp = lambda x: captured.append(x.copy()) or x  # noqa: E731
+        for _ in range(8):
+            b.submit("k", disp, np.zeros((1, 1)), qos_class="interactive")
+        for _ in range(4):
+            b.submit("k", disp, np.ones((1, 1)), qos_class="batch")
+        for _ in range(4):
+            b.submit("k", disp, np.full((1, 1), 2.0), qos_class="scavenger")
+
+        rows_seen = 0
+        while rows_seen < 16:
+            clock.advance(0.02)
+            assert b.flush_due() >= 1
+            rows_seen = sum(c.shape[0] for c in captured)
+        # exact drain: [5,2,1] then the leftovers [3,2,3]
+        assert [class_counts(c[:8]) for c in captured] == [
+            {0: 5, 1: 2, 2: 1},
+            {0: 3, 1: 2, 2: 3},
+        ]
+        assert all(2 in class_counts(c[:8]) for c in captured)
+
+    def test_unknown_class_degrades_to_default_lane(self):
+        """Taxonomy drift must degrade, never reject: a bogus class name
+        rides the default lane and the result meta says which one."""
+        b, _ = make_batcher(qos=three_tier_policy())
+        fut = b.submit("k", lambda x: x, np.ones((2, 1)), qos_class="bogus")
+        b.flush_due(force=True)
+        _, meta = fut.result(timeout=0)
+        assert meta["qos_class"] == "batch"
+
+
+# ---------------------------------------------------------------------------
+# strict-priority preemption at flush
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionAtFlush:
+    def test_high_priority_batch_dispatches_first(self):
+        """Two keys become flushable in the same pass; the one carrying
+        the more urgent rider dispatches first even though the scavenger
+        key was enqueued (and assembled) earlier."""
+        b, clock = make_batcher(qos=three_tier_policy())
+        order = []
+        b.submit(
+            "low", lambda x: order.append("low") or x, np.ones((4, 1)),
+            qos_class="scavenger",
+        )
+        b.submit(
+            "high", lambda x: order.append("high") or x, np.ones((4, 1)),
+            qos_class="interactive",
+        )
+        clock.advance(0.02)
+        assert b.flush_due() == 2
+        assert order == ["high", "low"]
+
+    def test_equal_priority_keeps_assembly_order(self):
+        b, clock = make_batcher(qos=three_tier_policy())
+        order = []
+        b.submit(
+            "first", lambda x: order.append("first") or x, np.ones((4, 1)),
+            qos_class="batch",
+        )
+        b.submit(
+            "second", lambda x: order.append("second") or x, np.ones((4, 1)),
+            qos_class="batch",
+        )
+        clock.advance(0.02)
+        assert b.flush_due() == 2
+        assert order == ["first", "second"]  # stable sort
+
+
+# ---------------------------------------------------------------------------
+# deadline-attribution regression (the batched_at bugfix)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineAttribution:
+    """A deadline-cancelled request must shed against the stage that
+    actually consumed its deadline: once assembly reached it but closed
+    the batch without it, the remaining wait is batch formation."""
+
+    def _ab_setup(self, slo):
+        b, clock = make_batcher(slo=slo)  # classless path — bugfix is shared
+        done = []
+        disp = lambda x: done.append(x.shape) or x  # noqa: E731
+        fut_a = b.submit("k", disp, np.ones((6, 1)), meta={"domain": "d"})
+        fut_b = b.submit(
+            "k", disp, np.ones((6, 1)), deadline_s=0.05, meta={"domain": "d"}
+        )
+        return b, clock, fut_a, fut_b
+
+    def test_expiry_after_assembly_reached_it_is_batch_wait(self):
+        slo = SloTracker()
+        b, clock, fut_a, fut_b = self._ab_setup(slo)
+        # 12 rows ≥ bucket 8: due now. A dispatches alone (B doesn't fit);
+        # assembly reached B and stamps batched_at = 0.0 < deadline 0.05.
+        assert b.flush_due() == 1
+        assert fut_a.result(timeout=0)
+        clock.advance(0.06)  # past B's deadline, still pre-dispatch
+        b.flush_due()
+        with pytest.raises(DeadlineExceeded):
+            fut_b.result(timeout=0)
+        shed = slo.shed_block()["by_domain"]["d"]
+        assert shed == {"expired": {"batch_wait": 1}}
+
+    def test_expiry_before_assembly_ever_reached_it_is_queue_wait(self):
+        slo = SloTracker()
+        b, clock = make_batcher(slo=slo)
+        fut = b.submit(
+            "k", lambda x: x, np.ones((2, 1)), deadline_s=0.05,
+            meta={"domain": "d"},
+        )
+        clock.advance(0.06)  # first flush only happens past the deadline
+        b.flush_due()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=0)
+        shed = slo.shed_block()["by_domain"]["d"]
+        assert shed == {"expired": {"queue_wait": 1}}
+
+    def test_deadline_spent_before_batched_at_stays_queue_wait(self):
+        """batched_at alone is not enough: if the deadline was already
+        gone when assembly first reached the request, the budget was
+        consumed queueing — batched_at > deadline_at must NOT relabel."""
+        slo = SloTracker()
+        b, clock, fut_a, fut_b = self._ab_setup(slo)
+        clock.advance(0.10)  # B's deadline passes before any flush runs
+        b.flush_due()  # dispatches A; stamps B batched_at = 0.10 > 0.05
+        b.flush_due()  # pops B: expired, deadline_at <= batched_at
+        with pytest.raises(DeadlineExceeded):
+            fut_b.result(timeout=0)
+        shed = slo.shed_block()["by_domain"]["d"]
+        assert shed == {"expired": {"queue_wait": 1}}
+
+
+# ---------------------------------------------------------------------------
+# cost-predictive admission: token buckets priced by the capacity model
+# ---------------------------------------------------------------------------
+
+
+class FakeCapacity:
+    def __init__(self, qps):
+        self.qps = qps
+        self.calls = 0
+
+    def domain_block(self, domain):
+        self.calls += 1
+        if self.qps is None:
+            return None
+        return {"max_sustainable_qps": float(self.qps)}
+
+
+class TestAdmission:
+    def test_bucket_math_against_capacity_model(self):
+        """qps 10 x share 0.5 = 5 rps; burst_s 2 => 10 tokens, starting
+        full. Denial predicts the exact time until one token exists."""
+        policy = QosPolicy(
+            classes={"c": QosClass("c", priority=0, rate_share=0.5)},
+            default_class="c",
+        )
+        clock = FakeClock(100.0)
+        adm = AdmissionController(
+            policy, FakeCapacity(10.0), clock=clock, burst_s=2.0
+        )
+        for _ in range(10):
+            adm.admit("dom", "c")
+        with pytest.raises(AdmissionDenied) as ei:
+            adm.admit("dom", "c")
+        assert ei.value.rate == pytest.approx(5.0)
+        assert ei.value.retry_after_s == pytest.approx(1.0 / 5.0)
+
+        # refill: 0.4s * 5 rps = 2 tokens — exactly two more admits
+        clock.advance(0.4)
+        adm.admit("dom", "c")
+        adm.admit("dom", "c")
+        with pytest.raises(AdmissionDenied) as ei:
+            adm.admit("dom", "c")
+        assert ei.value.retry_after_s == pytest.approx(1.0 / 5.0)
+
+        snap = adm.snapshot()
+        assert snap["admitted"] == 12 and snap["denied"] == 2
+        assert snap["denied_by_class"] == {"c": 2}
+        assert snap["buckets"]["dom|c"]["rate_rps"] == pytest.approx(5.0)
+        assert snap["buckets"]["dom|c"]["burst"] == pytest.approx(10.0)
+
+    def test_rate_reads_are_cached(self):
+        """Pricing is O(1) per request: the capacity model is consulted
+        once per cache window, not once per admit."""
+        clock = FakeClock(0.0)
+        cap = FakeCapacity(10.0)
+        adm = AdmissionController(
+            three_tier_policy(), cap, clock=clock, burst_s=2.0
+        )
+        for _ in range(5):
+            adm.admit("dom", "interactive")
+        assert cap.calls == 1
+
+    def test_small_share_classes_shed_first_by_construction(self):
+        """Round-robin overload: scavenger's bucket (share 0.1) drains
+        first, then batch (0.3); interactive (0.6) rides through."""
+        clock = FakeClock(0.0)
+        adm = AdmissionController(
+            three_tier_policy(), FakeCapacity(10.0), clock=clock, burst_s=1.0
+        )
+        first_denied = []
+        for _ in range(4):  # 4 rounds at a frozen clock: no refill
+            for klass in ("interactive", "batch", "scavenger"):
+                try:
+                    adm.admit("dom", klass)
+                except AdmissionDenied as e:
+                    if e.klass not in first_denied:
+                        first_denied.append(e.klass)
+        assert first_denied == ["scavenger", "batch"]
+        assert "interactive" not in adm.denied_by_class
+
+    def test_unprimed_capacity_admits_everything(self):
+        """No observations yet (or an unpriceable domain): the bucket
+        arms itself from measurement — nothing is rejected blind."""
+        adm = AdmissionController(
+            three_tier_policy(), FakeCapacity(None), clock=FakeClock(),
+            burst_s=1.0,
+        )
+        for _ in range(100):
+            adm.admit("dom", "scavenger")
+        snap = adm.snapshot()
+        assert snap["admitted"] == 100 and snap["denied"] == 0
+        assert snap["buckets"] == {}
+
+    def test_no_capacity_model_admits(self):
+        adm = AdmissionController(
+            three_tier_policy(), None, clock=FakeClock()
+        )
+        adm.admit("dom", "scavenger")
+        assert adm.admitted == 1
+
+
+# ---------------------------------------------------------------------------
+# streaming: ResultStream semantics + the batcher's partial-row router
+# ---------------------------------------------------------------------------
+
+
+class TestResultStream:
+    def test_chunk_ordering_and_first_solved_stamp(self):
+        clock = FakeClock(10.0)
+        s = ResultStream("r1", 4, clock=clock)
+        clock.advance(1.0)
+        s.put([0, 1], "x01", 3)
+        assert s.t_first_solved == 11.0
+        clock.advance(1.0)
+        s.put([2], "x2", 7)
+        assert s.t_first_solved == 11.0  # first stamp only
+        s.finish("final", {"m": 1})
+
+        view = s.poll(0)
+        assert view["done"] and not view["failed"]
+        assert view["rows_streamed"] == 3 and view["cursor"] == 2
+        assert [c["gen"] for c in view["chunks"]] == [3, 7]
+        assert [c["rows"] for c in view["chunks"]] == [[0, 1], [2]]
+        # incremental poll resumes at the cursor
+        assert [c["gen"] for c in s.poll(1)["chunks"]] == [7]
+
+        got = list(s.chunks(timeout=0.1))
+        assert [c["gen"] for c in got] == [3, 7]
+        assert s.final == {"x_adv": "final", "meta": {"m": 1}}
+
+    def test_put_after_finish_is_dropped(self):
+        s = ResultStream("r2", 4, clock=FakeClock())
+        s.put([0], "x", 1)
+        s.finish("final")
+        s.put([1], "late", 2)
+        assert s.rows_streamed == 1 and s.poll(0)["cursor"] == 1
+
+    def test_consumer_early_close_discards_quietly(self):
+        """A walked-away consumer must never block or fail the producer:
+        buffered chunks drop, later puts drop, finish still lands."""
+        s = ResultStream("r3", 4, clock=FakeClock())
+        s.put([0], "x", 1)
+        s.close()
+        s.put([1], "x", 2)  # dropped, no error
+        assert s.poll(0)["chunks"] == []
+        s.finish("final")
+        assert s.done and s.final["x_adv"] == "final"
+
+
+class TestPartialRouter:
+    def test_global_rows_route_to_request_local_offsets(self):
+        """Batch-global solved-row indices map back to each rider's own
+        row numbering; a non-streaming batch-mate and padding rows route
+        nowhere; a raising sink never poisons the batch."""
+        b, _ = make_batcher()
+        a_calls, seen_ctx = [], []
+
+        def sink_a(rows, x_rows, gen):
+            a_calls.append((rows, np.asarray(x_rows).copy(), gen))
+
+        def sink_b(rows, x_rows, gen):
+            raise ValueError("broken consumer")
+
+        def dispatch(x):
+            router = current_ledger_context().get("partial_router")
+            seen_ctx.append(router is not None)
+            payload = np.arange(3.0).reshape(3, 1) * 10
+            router([1, 3, 4], payload, 7)  # row 1 -> A; rows 3,4 -> B
+            router([6, 7], np.zeros((2, 1)), 9)  # padding rows: no rider
+            return x
+
+        fut_a = b.submit(
+            "k", dispatch, np.ones((3, 1)), on_partial=sink_a
+        )
+        fut_b = b.submit(
+            "k", dispatch, np.ones((2, 1)), on_partial=sink_b
+        )
+        b.flush_due(force=True)
+        assert fut_a.result(timeout=0) and fut_b.result(timeout=0)
+        assert seen_ctx == [True]
+        assert len(a_calls) == 1
+        rows, x_rows, gen = a_calls[0]
+        assert rows == [1] and gen == 7
+        np.testing.assert_array_equal(x_rows, [[0.0]])
+
+    def test_no_rider_streams_no_router(self):
+        """The common case carries zero partial plumbing: without an
+        on_partial sink the dispatch context has no router at all."""
+        b, _ = make_batcher()
+        ctxs = []
+
+        def dispatch(x):
+            ctxs.append(current_ledger_context().get("partial_router"))
+            return x
+
+        fut = b.submit("k", dispatch, np.ones((2, 1)))
+        b.flush_due(force=True)
+        assert fut.result(timeout=0)
+        assert ctxs == [None]
+
+
+# ---------------------------------------------------------------------------
+# per-class shed attribution matrix
+# ---------------------------------------------------------------------------
+
+
+class TestClassShedMatrix:
+    def test_matrix_shape_and_counts(self):
+        slo = SloTracker()
+        slo.shed("d", "expired", "queue_wait", qos_class="scavenger")
+        slo.shed("d", "expired", "queue_wait", qos_class="scavenger")
+        slo.shed("d", "expired", "batch_wait", qos_class="batch")
+        slo.shed("d", "rejected", "admission", qos_class="scavenger")
+        slo.shed("d", "rejected", "admission")  # classless: domain-only
+        block = slo.shed_block()
+        assert block["by_class"] == {
+            "batch": {"expired": {"batch_wait": 1}},
+            "scavenger": {
+                "expired": {"queue_wait": 2},
+                "rejected": {"admission": 1},
+            },
+        }
+        assert block["by_domain"]["d"]["rejected"]["admission"] == 2
+
+    def test_batcher_sheds_carry_the_class_label(self):
+        """Both shed paths the batcher owns — deadline expiry and a
+        poisoned batch — attribute to each rider's own class."""
+        slo = SloTracker()
+        b, clock = make_batcher(qos=three_tier_policy(), slo=slo)
+        fut = b.submit(
+            "k", lambda x: x, np.ones((2, 1)), deadline_s=0.01,
+            meta={"domain": "d"}, qos_class="scavenger",
+        )
+        clock.advance(0.02)
+        b.flush_due()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=0)
+
+        def boom(x):
+            raise RuntimeError("poisoned")
+
+        f1 = b.submit(
+            "k2", boom, np.ones((1, 1)), meta={"domain": "d"},
+            qos_class="interactive",
+        )
+        f2 = b.submit(
+            "k2", boom, np.ones((1, 1)), meta={"domain": "d"},
+            qos_class="batch",
+        )
+        b.flush_due(force=True)
+        assert f1.exception(timeout=0) and f2.exception(timeout=0)
+
+        assert slo.shed_block()["by_class"] == {
+            "batch": {"poisoned": {"dispatch": 1}},
+            "interactive": {"poisoned": {"dispatch": 1}},
+            "scavenger": {"expired": {"queue_wait": 1}},
+        }
+
+
+# ---------------------------------------------------------------------------
+# QoS off-switch contract: bit-identical results, zero extra compiles
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def qos_artifacts(tmp_path_factory):
+    """Tiny synthetic-LCLD artifact family, same recipe as the serving
+    tests' fixture (module-local: fixtures don't cross test files)."""
+    from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld_schema
+
+    tmp = tmp_path_factory.mktemp("qos_artifacts")
+    paths = synth_lcld_schema(str(tmp))
+    cons = LcldConstraints(paths["features"], paths["constraints"])
+    x = synth_lcld(64, cons.schema, seed=11)
+    cons.check_constraints_error(x)
+
+    model = lcld_mlp()
+    sur = Surrogate(model, init_params(model, cons.schema.n_features, seed=3))
+    save_params(sur, str(tmp / "nn.msgpack"))
+
+    from sklearn.preprocessing import MinMaxScaler
+    import joblib
+
+    xl, xu = cons.get_feature_min_max(dynamic_input=x)
+    xl = np.broadcast_to(np.asarray(xl, float), x.shape)
+    xu = np.broadcast_to(np.asarray(xu, float), x.shape)
+    scaler = MinMaxScaler().fit(np.vstack([x, xl, xu]))
+    joblib.dump(scaler, tmp / "scaler.joblib")
+    return {
+        "pool": x,
+        "domain": {
+            "project_name": "lcld",
+            "norm": 2,
+            "paths": {
+                "model": str(tmp / "nn.msgpack"),
+                "features": paths["features"],
+                "constraints": paths["constraints"],
+                "ml_scaler": str(tmp / "scaler.joblib"),
+            },
+            "system": {"mesh_devices": 0},
+        },
+    }
+
+
+class TestQosOffIdentity:
+    def test_qos_off_bit_identical_zero_extra_compiles(self, qos_artifacts):
+        """The whole QoS layer is host-side bookkeeping: turning it on
+        must change no result bit and add no compiles or dispatches for
+        the same request sequence (PGD is per-row deterministic at a
+        fixed bucket shape; the engine cache is process-wide, so the
+        second service re-uses the first's compiled programs)."""
+        pool = qos_artifacts["pool"]
+        reqs = [pool[0:5], pool[10:18]]  # both land in the 8-bucket
+        led = get_ledger()
+
+        def run(svc):
+            mark = led.mark()
+            outs = [
+                svc.attack(
+                    AttackRequest(domain="lcld", x=x, budget=3, eps=0.2),
+                    timeout=120.0,
+                ).x_adv
+                for x in reqs
+            ]
+            return outs, led.cost_block(since=mark)
+
+        svc_off = AttackService(
+            {"lcld": qos_artifacts["domain"]},
+            bucket_sizes=(8,), max_delay_s=0.005, qos=None,
+        )
+        try:
+            off_outs, off_cost = run(svc_off)
+        finally:
+            svc_off.close()
+
+        svc_on = AttackService(
+            {"lcld": qos_artifacts["domain"]},
+            bucket_sizes=(8,), max_delay_s=0.005, qos=three_tier_policy(),
+        )
+        try:
+            on_outs, on_cost = run(svc_on)
+        finally:
+            svc_on.close()
+
+        assert all(
+            np.array_equal(a, b) for a, b in zip(off_outs, on_outs)
+        )
+        extra_compiles = sum(
+            1 for e in on_cost["entries"] if e.get("compile_s", 0) > 0
+        )
+        assert extra_compiles == 0
+        assert on_cost["dispatches"] == off_cost["dispatches"]

@@ -155,6 +155,17 @@ DEFAULT_FLEET_RECOVERY_FLOOR = 0.6
 #: under --fleet (trajectory gate on top of the absolute floor).
 DEFAULT_FLEET_THRESHOLD = 0.15
 
+#: absolute floor on the QOS record's scavenger shed share under --qos:
+#: at saturation with the mixed-class offered load, the lowest class must
+#: absorb >= 80% of everything shed — the low-priority-absorbs-overload
+#: invariant (admission buckets shed scavenger first by construction).
+DEFAULT_QOS_SCAVENGER_SHED_FLOOR = 0.8
+
+#: absolute floor on time_to_complete_s / time_to_first_solved_s for the
+#: streaming early-exit workload under --qos: solved rows must surface at
+#: least 2x sooner than the full result (the acceptance criterion).
+DEFAULT_QOS_TTFS_RATIO_FLOOR = 2.0
+
 
 #: o-columns tracked at each interior budget: o2 (misclassified) and o7
 #: (the full constrained-adversarial criterion) — the two the round-5
@@ -1205,6 +1216,175 @@ def fleet_check(
     return lines, regressed, entries
 
 
+def qos_check(
+    paths: list[str],
+    *,
+    shed_floor: float = DEFAULT_QOS_SCAVENGER_SHED_FLOOR,
+    ttfs_floor: float = DEFAULT_QOS_TTFS_RATIO_FLOOR,
+) -> tuple[list[str], bool, list[dict]]:
+    """The --qos gate over the committed ``QOS_r*.json`` series.
+
+    Absolute gates on the LATEST record (acceptance criteria, like
+    --fleet's):
+
+    - interactive p99 at saturation <= the record's own SLO target (the
+      target is derived from the record's light-load baseline and
+      committed next to the measurement, so the gate is self-describing);
+    - scavenger's share of everything shed >= ``shed_floor`` — the
+      low-priority-absorbs-overload invariant;
+    - the streaming time_to_complete/time_to_first_solved ratio >=
+      ``ttfs_floor`` on the early-exit workload;
+    - the QoS-off identity proof: bit-identical rows, zero extra
+      compiles, equal dispatch counts.
+
+    A latest record that LOST any of these captures FAILS — the gate
+    must not be disarmable by dropping the measurement. No records at
+    all passes trivially (the gate arms with the first committed QOS
+    record)."""
+    lines: list[str] = []
+    regressed = False
+    entries: list[dict] = []
+
+    def fail(metric: str, msg: str, **extra):
+        nonlocal regressed
+        regressed = True
+        lines.append(f"  qos.{metric}: {msg} — FAIL")
+        entries.append(
+            {"metric": f"qos.{metric}", "verdict": "regression", **extra}
+        )
+
+    def ok(metric: str, msg: str, **extra):
+        lines.append(f"  qos.{metric}: {msg} — ok")
+        entries.append({"metric": f"qos.{metric}", "verdict": "ok", **extra})
+
+    if not paths:
+        lines.append("  qos: no QOS_r*.json records — gate unarmed, passing")
+        return lines, False, entries
+    records = []
+    for p in paths:
+        doc = load_record(p)
+        rec = doc.get("qos") if isinstance(doc, dict) else None
+        records.append((p, rec))
+    latest_path, latest = records[-1]
+    lines.append(f"  qos: gating {latest_path}")
+    if not isinstance(latest, dict):
+        fail("record", f"{latest_path} carries no qos payload (lost capture)")
+        return lines, regressed, entries
+
+    # -- absolute: interactive p99 vs its committed SLO target ---------------
+    sat = latest.get("saturation") or {}
+    p99 = sat.get("interactive_p99_ms")
+    target = sat.get("slo_target_ms")
+    if not isinstance(p99, (int, float)) or not isinstance(
+        target, (int, float)
+    ):
+        fail(
+            "saturation.interactive_p99",
+            f"p99 {p99} / SLO target {target} missing — a saturation run "
+            "that never measured interactive latency proves nothing",
+        )
+    elif p99 > target:
+        fail(
+            "saturation.interactive_p99",
+            f"{p99:g} ms > SLO target {target:g} ms at offered "
+            f"{sat.get('offered_rps')} rps",
+            value=p99,
+            target=target,
+        )
+    else:
+        ok(
+            "saturation.interactive_p99",
+            f"{p99:g} ms <= SLO target {target:g} ms at offered "
+            f"{sat.get('offered_rps')} rps (capacity "
+            f"{sat.get('max_sustainable_qps')})",
+            value=p99,
+            target=target,
+        )
+
+    # -- absolute: who absorbed the overload ---------------------------------
+    share = sat.get("scavenger_shed_share")
+    totals = sat.get("shed_totals")
+    if not isinstance(share, (int, float)):
+        fail(
+            "saturation.scavenger_shed_share",
+            f"null (shed totals {totals}) — a saturation run that shed "
+            "nothing never reached saturation",
+        )
+    elif share < shed_floor:
+        fail(
+            "saturation.scavenger_shed_share",
+            f"{share:.3f} < floor {shed_floor:g} (shed totals {totals}) — "
+            "overload leaked past the scavenger class",
+            value=share,
+            floor=shed_floor,
+        )
+    else:
+        ok(
+            "saturation.scavenger_shed_share",
+            f"{share:.3f} >= floor {shed_floor:g} (shed totals {totals})",
+            value=share,
+            floor=shed_floor,
+        )
+
+    # -- absolute: streaming time-to-first-solved -----------------------------
+    streaming = latest.get("streaming") or {}
+    ratio = streaming.get("ttfs_ratio")
+    if not isinstance(ratio, (int, float)):
+        fail(
+            "streaming.ttfs_ratio",
+            f"null (first solved {streaming.get('time_to_first_solved_s')}, "
+            f"complete {streaming.get('time_to_complete_s')}) — no partial "
+            "rows ever streamed",
+        )
+    elif ratio < ttfs_floor:
+        fail(
+            "streaming.ttfs_ratio",
+            f"{ratio:g} < floor {ttfs_floor:g} (first solved "
+            f"{streaming.get('time_to_first_solved_s')}s vs complete "
+            f"{streaming.get('time_to_complete_s')}s)",
+            value=ratio,
+            floor=ttfs_floor,
+        )
+    else:
+        ok(
+            "streaming.ttfs_ratio",
+            f"{ratio:g} >= floor {ttfs_floor:g} "
+            f"({streaming.get('rows_streamed')}/{streaming.get('n_rows')} "
+            "rows streamed before completion)",
+            value=ratio,
+            floor=ttfs_floor,
+        )
+
+    # -- absolute: the QoS-off overhead contract ------------------------------
+    identity = latest.get("identity") or {}
+    bit = identity.get("bit_identical")
+    extra = identity.get("extra_compiles")
+    d_eq = identity.get("dispatches_equal")
+    if bit is not True:
+        fail(
+            "identity.bit_identical",
+            f"{bit} — QoS off must reproduce the pre-QoS path bit-for-bit",
+            value=bit,
+        )
+    elif extra != 0 or d_eq is not True:
+        fail(
+            "identity.zero_extra_work",
+            f"extra_compiles={extra}, dispatches "
+            f"{identity.get('dispatches_off')} vs "
+            f"{identity.get('dispatches_on')} — QoS bookkeeping leaked "
+            "into the device path",
+            extra_compiles=extra,
+        )
+    else:
+        ok(
+            "identity.zero_extra_work",
+            f"bit-identical, extra_compiles=0, dispatches "
+            f"{identity.get('dispatches_off')}=="
+            f"{identity.get('dispatches_on')}",
+        )
+    return lines, regressed, entries
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -1337,6 +1517,31 @@ def main(argv=None) -> int:
         f"fails under --fleet (default {DEFAULT_FLEET_THRESHOLD})",
     )
     parser.add_argument(
+        "--qos",
+        action="store_true",
+        help="also gate the committed QOS_r*.json series (globbed in cwd): "
+        "interactive p99 at saturation against the record's own SLO "
+        "target, the scavenger class's share of the shed against an "
+        "absolute floor (low priority absorbs overload), the streaming "
+        "time-to-first-solved ratio, and the QoS-off "
+        "bit-identity/zero-extra-compiles proof. Lost capture fails; no "
+        "QOS records passes (the gate arms with the first)",
+    )
+    parser.add_argument(
+        "--qos-shed-floor",
+        type=float,
+        default=DEFAULT_QOS_SCAVENGER_SHED_FLOOR,
+        help="absolute scavenger-shed-share floor under --qos "
+        f"(default {DEFAULT_QOS_SCAVENGER_SHED_FLOOR})",
+    )
+    parser.add_argument(
+        "--qos-ttfs-floor",
+        type=float,
+        default=DEFAULT_QOS_TTFS_RATIO_FLOOR,
+        help="absolute time_to_complete/time_to_first_solved floor under "
+        f"--qos (default {DEFAULT_QOS_TTFS_RATIO_FLOOR})",
+    )
+    parser.add_argument(
         "--json",
         action="store_true",
         help="append one machine-readable JSON line (per-metric basis, "
@@ -1364,7 +1569,19 @@ def main(argv=None) -> int:
             threshold=args.fleet_threshold,
         )
 
-    if not paths and not args.fleet:
+    # the QOS series mirrors the FLEET discipline: its own file family,
+    # gated independently of the BENCH series
+    qos_lines: list[str] = []
+    qos_regressed = False
+    qos_entries: list[dict] = []
+    if args.qos:
+        qos_lines, qos_regressed, qos_entries = qos_check(
+            sorted(glob.glob("QOS_r*.json")),
+            shed_floor=args.qos_shed_floor,
+            ttfs_floor=args.qos_ttfs_floor,
+        )
+
+    if not paths and not args.fleet and not args.qos:
         parser.error("no bench records given (and --check found none)")
 
     # records are taken in the order GIVEN (oldest first, per the CLI
@@ -1389,17 +1606,26 @@ def main(argv=None) -> int:
                 if fleet_regressed
                 else "bench_diff: fleet ok"
             )
+        if qos_lines:
+            print("qos gate:")
+            print("\n".join(qos_lines))
+            print(
+                "bench_diff: qos REGRESSION — failing"
+                if qos_regressed
+                else "bench_diff: qos ok"
+            )
         if args.json:
             print(
                 json.dumps(
-                    {"regressed": fleet_regressed,
+                    {"regressed": fleet_regressed or qos_regressed,
                      "reason": "insufficient_records",
                      "usable_records": len(records),
                      "fleet": args.fleet,
-                     "metrics": fleet_entries}
+                     "qos": args.qos,
+                     "metrics": fleet_entries + qos_entries}
                 )
             )
-        return 1 if fleet_regressed else 0
+        return 1 if (fleet_regressed or qos_regressed) else 0
 
     print(
         f"bench_diff: {records[-1][0]} vs {len(records) - 1} earlier "
@@ -1423,8 +1649,11 @@ def main(argv=None) -> int:
     if fleet_lines:
         print("fleet gate:")
         print("\n".join(fleet_lines))
-    regressed = regressed or fleet_regressed
-    entries = entries + fleet_entries
+    if qos_lines:
+        print("qos gate:")
+        print("\n".join(qos_lines))
+    regressed = regressed or fleet_regressed or qos_regressed
+    entries = entries + fleet_entries + qos_entries
     if regressed:
         print("bench_diff: REGRESSION past threshold — failing")
     else:
@@ -1452,6 +1681,9 @@ def main(argv=None) -> int:
                     "fleet_warm_floor": args.fleet_warm_floor,
                     "fleet_recovery_floor": args.fleet_recovery_floor,
                     "fleet_threshold": args.fleet_threshold,
+                    "qos": args.qos,
+                    "qos_shed_floor": args.qos_shed_floor,
+                    "qos_ttfs_floor": args.qos_ttfs_floor,
                     "regressed": regressed,
                     "metrics": entries,
                 }

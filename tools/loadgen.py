@@ -27,9 +27,18 @@ Use ``poisson`` with an explicit ``--rps`` for saturation/knee
 measurements, with ``--concurrency`` high enough that in-flight
 requests rarely saturate it.
 
+QoS traffic mix (``--mix``): ``--mix interactive=0.2,batch=0.7,\
+scavenger=0.1`` draws each request's priority class from the given
+weights (seeded — the same ``--seed`` reproduces the same per-request
+class sequence), sends it as the payload's ``"priority"`` field, and
+splits the report per class (``by_class``: status breakdown + latency
+quantiles), so a saturation run shows directly which class absorbed the
+shed and which held its SLO.
+
     python tools/loadgen.py --url http://127.0.0.1:8787 --domain lcld \
         --requests 64 --concurrency 8 --rows-min 1 --rows-max 13 \
-        --rps 50 --arrival poisson
+        --rps 50 --arrival poisson \
+        --mix interactive=0.2,batch=0.7,scavenger=0.1
 """
 
 from __future__ import annotations
@@ -100,7 +109,28 @@ def post_attack(url: str, payload: dict, timeout: float, t0: float | None = None
         return f"error:{type(e).__name__}", time.monotonic() - t0
 
 
+def parse_mix(spec: str | None) -> list[tuple[str, float]] | None:
+    """``interactive=0.2,batch=0.7,scavenger=0.1`` -> [(name, weight)].
+    Weights need not sum to 1 (they are normalized at draw time); zero
+    and negative weights are rejected rather than silently dropped."""
+    if not spec:
+        return None
+    mix = []
+    for part in spec.split(","):
+        name, _, w = part.partition("=")
+        name = name.strip()
+        if not name or not w:
+            raise ValueError(f"bad --mix entry {part!r} (want name=weight)")
+        weight = float(w)
+        if weight <= 0:
+            raise ValueError(f"--mix weight for {name!r} must be > 0")
+        mix.append((name, weight))
+    return mix
+
+
 def run(args) -> dict:
+    import random
+
     from moeva2_ijcai22_replication_tpu.utils.config import load_config_file
 
     domain_cfg = load_config_file(args.config)["domains"][args.domain]
@@ -120,6 +150,19 @@ def run(args) -> dict:
             file=sys.stderr,
         )
     offsets = arrival_offsets(args.arrival, args.rps, args.requests, args.seed)
+    # per-request priority classes: one seeded draw per request (distinct
+    # stream from the arrival process so adding --mix never perturbs the
+    # arrival schedule of an otherwise-identical run)
+    mix = parse_mix(args.mix)
+    if mix:
+        rng = random.Random(args.seed * 7919 + 13)
+        classes = rng.choices(
+            [name for name, _ in mix],
+            weights=[w for _, w in mix],
+            k=args.requests,
+        )
+    else:
+        classes = [None] * args.requests
     t_start = time.monotonic()
 
     def one(i: int):
@@ -131,6 +174,8 @@ def run(args) -> dict:
             "loss_evaluation": args.loss_evaluation,
             "request_id": f"loadgen-{i}",
         }
+        if classes[i] is not None:
+            payload["priority"] = classes[i]
         # PACED runs charge latency from the SCHEDULED arrival, not when a
         # worker thread frees up: executor-queue wait is queueing the
         # client observed, and excluding it would reintroduce coordinated
@@ -158,6 +203,27 @@ def run(args) -> dict:
     for status, _ in results:
         statuses[status] = statuses.get(status, 0) + 1
     ok_lat = sorted(dt for status, dt in results if status == "ok")
+    # per-class report (only with --mix): the client-side evidence of
+    # who got served and who got shed at this offered load
+    by_class: dict[str, dict] = {}
+    if mix:
+        for (status, dt), klass in zip(results, classes):
+            c = by_class.setdefault(
+                klass, {"requests": 0, "statuses": {}, "_lat": []}
+            )
+            c["requests"] += 1
+            c["statuses"][status] = c["statuses"].get(status, 0) + 1
+            if status == "ok":
+                c["_lat"].append(dt)
+        for c in by_class.values():
+            lat = sorted(c.pop("_lat"))
+            c["p50_ms"] = (
+                round(percentile(lat, 0.50) * 1e3, 2) if lat else None
+            )
+            c["p99_ms"] = (
+                round(percentile(lat, 0.99) * 1e3, 2) if lat else None
+            )
+            c["quantiles_n"] = len(lat)
     return {
         "url": args.url,
         "domain": args.domain,
@@ -171,6 +237,8 @@ def run(args) -> dict:
         "p99_ms": round(percentile(ok_lat, 0.99) * 1e3, 2) if ok_lat else None,
         "quantiles_n": len(ok_lat),
         "statuses": statuses,
+        **({"mix": dict(mix), "by_class": dict(sorted(by_class.items()))}
+           if mix else {}),
     }
 
 
@@ -198,7 +266,14 @@ def main(argv=None) -> int:
                         "worker slot — so queueing is never hidden "
                         "(no coordinated omission)")
     parser.add_argument("--seed", type=int, default=42,
-                        help="RNG seed for --arrival poisson")
+                        help="RNG seed for --arrival poisson and --mix")
+    parser.add_argument("--mix", default=None,
+                        help="QoS traffic mix, e.g. "
+                        "'interactive=0.2,batch=0.7,scavenger=0.1': draw "
+                        "each request's priority class from these weights "
+                        "(seeded per-request sequence), send it as the "
+                        "payload 'priority', and report per-class "
+                        "latency/shed under 'by_class'")
     parser.add_argument("--rows-min", type=int, default=1)
     parser.add_argument("--rows-max", type=int, default=13)
     parser.add_argument("--eps", type=float, default=0.2)

@@ -23,7 +23,11 @@ pollute another gate's process state):
   per-device balance + hot-loop collectives, the device overlap /
   cold-steady ratios, the ABSOLUTE cold/steady ceiling (1.2 — ROADMAP
   item 2's exit criterion), and the warm-start hit rate
-  ((hit + aot_hit) / classified executables).
+  ((hit + aot_hit) / classified executables). ``--qos`` additionally
+  gates the committed ``QOS_r*.json`` series: per-class knee p99 held,
+  the low-priority-absorbs-overload invariant (scavenger's shed share),
+  the streaming time-to-first-solved ratio, and the QoS-off
+  bit-identity/zero-extra-compiles proof.
 - ``shard_lint`` — the states-sharding contract (``tools/shard_lint.py
   --check``): compiles the committed attack programs on the emulated
   8-device CPU mesh and fails on hot-loop float collectives, oversized
@@ -60,7 +64,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = {
     "bench_diff": (
         "bench_diff.py",
-        ["--check", "--slo", "--mesh", "--overlap", "--cold", "--fleet"],
+        ["--check", "--slo", "--mesh", "--overlap", "--cold", "--fleet",
+         "--qos"],
     ),
     "shard_lint": ("shard_lint.py", ["--check"]),
     "domain_lint": ("domain_lint.py", ["--check"]),

@@ -55,13 +55,16 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from moeva2_ijcai22_replication_tpu.experiments.common import setup_jax_cache
-    from moeva2_ijcai22_replication_tpu.serving import AttackService
+    from moeva2_ijcai22_replication_tpu.serving import AttackService, QosPolicy
     from moeva2_ijcai22_replication_tpu.serving.server import serve
     from moeva2_ijcai22_replication_tpu.utils.config import load_config_file
 
     cfg = load_config_file(args.c)
     srv_cfg = cfg.get("serving", {})
     setup_jax_cache(cfg)
+    # QoS: priority classes + admission + streaming (serving.qos block;
+    # absent or enabled:false -> the exact pre-QoS single-queue path)
+    qos = QosPolicy.from_config(srv_cfg.get("qos"))
 
     # request tracing: a JSONL sink enables spans — every /attack response
     # then returns its own span tree and the stream renders in Perfetto via
@@ -86,6 +89,7 @@ def main(argv=None) -> int:
         slo_buckets=srv_cfg.get("slo_histogram_buckets"),
         capacity_window=srv_cfg.get("capacity_window", 256),
         replica_id=args.replica_id,
+        qos=qos,
     )
     # boot-time prewarm: BEFORE the HTTP front binds, so the first caller
     # never pays a compile (engines are single-dispatch objects — this

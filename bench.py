@@ -684,6 +684,8 @@ def run_fleet_bench() -> dict | None:
         # compiles into it, every measured replica AOT-loads from it —
         # the record's warm fractions prove exactly this directory's worth
         run_dir = tempfile.mkdtemp(prefix="bench_fleet_")
+        trace_dir = os.path.join(run_dir, "traces")
+        os.makedirs(trace_dir, exist_ok=True)
         cfg = {
             "domains": {
                 "lcld": {
@@ -708,6 +710,12 @@ def run_fleet_bench() -> dict | None:
                 "request_timeout_s": 30.0,
                 "capacity_window": 256,
                 "prewarm": True,
+                # fleet tracing on: per-replica JSONL sinks (templated
+                # trace_r01.jsonl, ...) merged after the sweep into the
+                # committed cross-replica Perfetto doc; the flight ring
+                # makes chaos losses attributable from the harvested dump
+                "trace_log": os.path.join(trace_dir, "trace.jsonl"),
+                "flight_dir": os.path.join(run_dir, "flight"),
             },
             "system": {"jax_cache_dir": os.path.join(run_dir, "jax_cache")},
         }
@@ -741,20 +749,128 @@ def run_fleet_bench() -> dict | None:
         env.pop("MOEVA2_AOT_CACHE_DISABLE", None)
         env["JAX_PLATFORMS"] = os.environ.get("BENCH_FLEET_PLATFORM", "cpu")
 
-        record = fleet_sweep(
-            config_path,
-            make_body,
-            counts=counts,
-            per_replica_rates=rates,
-            n_requests=n_requests,
-            chaos=not os.environ.get("BENCH_FLEET_SKIP_CHAOS"),
-            manager_kw={
-                "env": env,
-                "log_dir": os.path.join(run_dir, "logs"),
-            },
+        # the router's own spans ride a sink too, so the merged doc shows
+        # route -> attempt spans ABOVE the replicas' request trees (one
+        # trace id across processes: replicas adopt X-Moeva2-Trace)
+        from moeva2_ijcai22_replication_tpu.observability import TraceRecorder
+        from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+            merge_fleet_traces,
+            replica_sink_path,
         )
+
+        router_sink = os.path.join(trace_dir, "trace_router.jsonl")
+        router_rec = TraceRecorder(sink_path=router_sink)
+        try:
+            record = fleet_sweep(
+                config_path,
+                make_body,
+                counts=counts,
+                per_replica_rates=rates,
+                n_requests=n_requests,
+                chaos=not os.environ.get("BENCH_FLEET_SKIP_CHAOS"),
+                manager_kw={
+                    "env": env,
+                    "log_dir": os.path.join(run_dir, "logs"),
+                },
+                router_kw={"recorder": router_rec},
+            )
+        finally:
+            router_rec.close()
         record["artifacts"] = art["kind"]
         record["serving_config"] = cfg["serving"]
+
+        # merge the per-process sinks onto the router's wall clock (each
+        # replica's offset was measured at its last /healthz poll). The
+        # FULL doc stays in the run dir (MBs — every request of the
+        # sweep); the committed doc is pruned to the cross-process traces
+        # (one id spanning router + replica sinks), which is the proof
+        merge_out = os.environ.get(
+            "BENCH_FLEET_TRACE_OUT", os.path.join("out", "fleet_trace.json")
+        )
+        full_out = os.path.join(trace_dir, "fleet_trace_full.json")
+        sinks = {"router": router_sink}
+        offsets: dict[str, float] = {}
+        for r in record["fleet_final"]["replicas"]:
+            rid = r["replica_id"]
+            sinks[rid] = replica_sink_path(
+                cfg["serving"]["trace_log"], rid
+            )
+            offsets[rid] = r.get("clock_offset_s") or 0.0
+        doc = merge_fleet_traces(sinks, offsets, out_path=full_out)
+        merge_report = doc["otherData"]["fleet_merge"]
+        # cross-process trace ids: events in MORE than one source sink
+        # (the router's attempt span + the replica tree that adopted its
+        # X-Moeva2-Trace id — the end-to-end journey the merge exists for)
+        from moeva2_ijcai22_replication_tpu.observability.export import (
+            read_jsonl,
+        )
+
+        trace_sources: dict[str, set] = {}
+        for label, path in sinks.items():
+            if not os.path.exists(path):
+                continue
+            for ev in read_jsonl(path):
+                tid = ev.get("trace")
+                if tid:
+                    trace_sources.setdefault(tid, set()).add(label)
+        cross = sorted(
+            t for t, srcs in trace_sources.items() if len(srcs) > 1
+        )
+        # EVERY routed request is cross-process (the replica adopts the
+        # router's id), so the committed subset is the failover chains —
+        # connection-cause first (the requests that crossed the chaos
+        # kill), capped; dropped counts stay on the record (no silent cap)
+        failover_causes: dict[str, set] = {}
+        for ev in read_jsonl(router_sink):
+            if ev.get("kind") == "event" and ev.get("name") == "failover":
+                failover_causes.setdefault(ev.get("trace"), set()).add(
+                    (ev.get("attrs") or {}).get("cause")
+                )
+        conn = sorted(
+            t for t, c in failover_causes.items() if "connection" in c
+        )
+        other = sorted(set(failover_causes) - set(conn))
+        keep = (conn + other)[:40] or cross[:8]
+        keep_pids = {
+            ev["pid"]
+            for ev in doc.get("traceEvents", [])
+            if ev.get("ph") == "M"
+            and ev.get("name") == "process_name"
+            and (ev.get("args") or {}).get("name") in set(keep)
+        }
+        pruned = dict(
+            doc,
+            traceEvents=[
+                ev
+                for ev in doc.get("traceEvents", [])
+                if ev.get("pid") in keep_pids
+            ],
+        )
+        pruned["otherData"] = dict(
+            doc.get("otherData") or {},
+            pruned_to="failover_traces",
+            kept_traces=len(keep),
+            cross_process_total=len(cross),
+            full_doc=full_out,
+        )
+        with open(merge_out, "w") as f:
+            json.dump(pruned, f)
+        record["trace_merge"] = {
+            "out_path": merge_out,
+            "full_doc": full_out,
+            "events": sum(
+                v["events"] for v in merge_report["replicas"].values()
+            ),
+            "replicas": merge_report["replicas"],
+            "skipped": merge_report["skipped"],
+            "cross_process_traces": len(cross),
+            "failover_traces": {
+                "connection": len(conn),
+                "other": len(other),
+                "committed": len(keep),
+            },
+            "committed_events": len(pruned["traceEvents"]),
+        }
         for stage in record["stages"]:
             knee = stage["knee"]["knee_rps"]
             log(
@@ -772,14 +888,31 @@ def run_fleet_bench() -> dict | None:
         )
         if record.get("chaos"):
             acct = record["chaos"]["shed_accounting"]
+            flight = acct.get("flight") or {}
+            attrib = flight.get("attribution") or {}
             log(
                 f"[bench] fleet chaos: killed "
                 f"{record['chaos']['kill'].get('replica_id')} with "
                 f"{acct['in_flight_at_kill']} in flight; lost "
                 f"{acct['lost_dead_replica']} (unaccounted "
                 f"{acct['lost_unaccounted']}), retried {acct['retried']}, "
-                f"recovery {record['chaos']['recovery']['recovery_ratio']}"
+                f"recovery {record['chaos']['recovery']['recovery_ratio']}; "
+                f"flight dump: {flight.get('harvested')} "
+                f"(attributed {attrib.get('attributed')}, untracked "
+                f"{len(attrib.get('untracked') or [])})"
             )
+        tm = record["trace_merge"]
+        log(
+            f"[bench] fleet trace merge: {tm['events']} events from "
+            f"{len(tm['replicas'])} sinks, {tm['cross_process_traces']} "
+            f"cross-process traces; committed {tm['committed_events']} "
+            f"events ({tm['failover_traces']}) -> {tm['out_path']}"
+        )
+        incs = record["telemetry"]["incidents"]
+        log(
+            f"[bench] fleet incidents: total {incs['total']} "
+            f"by_kind {incs['by_kind']} (open {incs['open']})"
+        )
         return record
     except Exception as e:
         log(f"[bench] fleet metric skipped: {e}")

@@ -39,6 +39,16 @@ from .coldstart import (
     get_coldstart,
     validate_cold,
 )
+from .fleetrace import (
+    TRACE_HEADER,
+    TRACE_VERSION,
+    clock_offset,
+    format_trace_context,
+    merge_fleet_events,
+    merge_fleet_traces,
+    parse_trace_context,
+)
+from .flightrec import FlightRecorder, load_flight_dump
 from .gaps import (
     GAPS,
     GAPS_KEYS,
@@ -51,6 +61,13 @@ from .gaps import (
     spans_from_recorder,
     spans_from_trace,
     validate_gaps,
+)
+from .incidents import (
+    INCIDENT_KEYS,
+    INCIDENT_KINDS,
+    IncidentDetector,
+    incidents_block,
+    validate_incidents,
 )
 from .ledger import (
     LEDGER,
@@ -126,6 +143,8 @@ __all__ = [
     "GAPS",
     "GAPS_KEYS",
     "HOT_LOOP_PRODUCERS",
+    "INCIDENT_KEYS",
+    "INCIDENT_KINDS",
     "LEDGER",
     "MESH",
     "MESH_KEYS",
@@ -134,12 +153,16 @@ __all__ = [
     "SHED_CAUSES",
     "SLO_KEYS",
     "STAGES",
+    "TRACE_HEADER",
+    "TRACE_VERSION",
     "CapacityModel",
     "ColdStartLedger",
     "CostLedger",
     "DispatchWindow",
+    "FlightRecorder",
     "GapTracker",
     "Histogram",
+    "IncidentDetector",
     "LedgerEntry",
     "LedgeredJit",
     "MeshCapture",
@@ -149,6 +172,7 @@ __all__ = [
     "all_device_memory_stats",
     "backend_fingerprint",
     "build_identity",
+    "clock_offset",
     "configure_aot_cache",
     "configure_coldstart",
     "configure_gap_tracker",
@@ -160,20 +184,26 @@ __all__ = [
     "detect_knee",
     "device_memory_stats",
     "emit_window_trace",
+    "format_trace_context",
     "get_aot_cache",
     "get_coldstart",
     "get_gap_tracker",
     "get_ledger",
     "get_mesh_capture",
+    "incidents_block",
     "interior_summary",
     "join_gaps_to_spans",
     "ledger_context",
+    "load_flight_dump",
     "maybe_span",
     "merge_chunk_quality",
+    "merge_fleet_events",
+    "merge_fleet_traces",
     "merge_histogram_snapshots",
     "merge_slo_snapshots",
     "mesh_block",
     "mesh_snapshot",
+    "parse_trace_context",
     "probe_collectives",
     "probe_shardings",
     "quality_block",
@@ -187,6 +217,7 @@ __all__ = [
     "use_trace",
     "validate_cold",
     "validate_gaps",
+    "validate_incidents",
     "validate_mesh",
     "validate_quality",
     "validate_record",

@@ -1,0 +1,203 @@
+"""Fleet-wide distributed tracing: context propagation + sink merging.
+
+Single-process tracing (``observability.trace``) dies at the router hop:
+the fleet :class:`~..serving.fleet.router.Router` forwards a request over
+HTTP and the replica starts a brand-new trace with no memory of which
+routing attempt (or which failover chain) produced it. This module is the
+cross-process glue:
+
+- **Context header** — the router stamps ``X-Moeva2-Trace`` (a
+  W3C-traceparent-shaped triple: trace id, parent span id, hop count) on
+  every forwarded/failover attempt; the replica parses it and adopts the
+  trace id + remote parent as the *root* of its existing request trace
+  (``Trace(root_parent=...)``). The replica's local ``meta.trace`` is
+  unharmed — ``Trace.tree()`` treats an unknown parent as a root — but in
+  a merged document the replica's request span parents correctly under
+  the router's attempt span. The delimiter is ``;`` (not the W3C ``-``)
+  because our trace ids legitimately contain dashes
+  (``r01:req-3f2a...``).
+- **Clock-offset handshake** — each replica's /healthz carries
+  ``now_wall``; the :class:`~..serving.fleet.replica.ReplicaManager`
+  brackets the poll with its own wall-clock reads and estimates the
+  replica↔router offset as ``remote_now − (t_send + t_recv)/2`` (the NTP
+  midpoint rule; error bounded by rtt/2). Good to a few ms on one host —
+  plenty against span durations of tens of ms, and honest: the rtt rides
+  along so a reader can see the bound.
+- **Sink merging** — :func:`merge_fleet_traces` aligns N per-replica
+  JSONL sinks onto one wall-clock timeline (each sink's meta line anchors
+  its monotonic epoch via ``t0_wall``; the measured offset corrects the
+  replica's wall clock) and renders one Chrome/Perfetto document whose
+  process tracks keep their replica-prefixed trace ids — the end-to-end
+  request journey the single-sink exporter could never show.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .export import read_jsonl, to_chrome_trace
+
+__all__ = [
+    "TRACE_HEADER",
+    "TRACE_VERSION",
+    "clock_offset",
+    "format_trace_context",
+    "merge_fleet_events",
+    "merge_fleet_traces",
+    "parse_trace_context",
+    "replica_sink_path",
+]
+
+#: the propagation header every router forward/failover attempt carries
+TRACE_HEADER = "X-Moeva2-Trace"
+
+#: context format version (leading field, room to evolve the schema)
+TRACE_VERSION = "00"
+
+
+def format_trace_context(
+    trace_id: str, parent_span: int | None = None, hop: int = 0
+) -> str:
+    """Render the ``X-Moeva2-Trace`` value: ``00;<trace>;<parent>;<hop>``.
+
+    ``parent_span`` 0 means "no recorded parent" (a router running
+    without a span recorder still propagates identity + hop count)."""
+    return (
+        f"{TRACE_VERSION};{trace_id};{int(parent_span or 0)};{int(hop)}"
+    )
+
+
+def parse_trace_context(header: str | None) -> dict | None:
+    """Parse a context header; None on absent/malformed/foreign-version
+    input (propagation is best-effort — a bad header must never fail the
+    request it rides on)."""
+    if not header:
+        return None
+    parts = str(header).split(";")
+    if len(parts) != 4 or parts[0] != TRACE_VERSION or not parts[1]:
+        return None
+    try:
+        parent = int(parts[2])
+        hop = int(parts[3])
+    except ValueError:
+        return None
+    return {
+        "trace_id": parts[1],
+        "parent_span": parent if parent > 0 else None,
+        "hop": hop,
+    }
+
+
+def replica_sink_path(trace_log: str, replica_id: str | None) -> str:
+    """Template a shared ``serving.trace_log`` path per replica
+    (``out/trace.jsonl`` -> ``out/trace_r01.jsonl``). N replicas share
+    ONE config file, and two processes appending to one JSONL would
+    corrupt both streams — so ``tools/serve.py`` writes here and the
+    fleet merge reads the same paths back."""
+    if not replica_id:
+        return trace_log
+    root, ext = os.path.splitext(trace_log)
+    return f"{root}_{replica_id}{ext or '.jsonl'}"
+
+
+def clock_offset(
+    t_send_wall: float, t_recv_wall: float, remote_now_wall: float
+) -> dict:
+    """NTP-midpoint offset estimate from one request/response bracket:
+    the remote clock read is assumed to happen at the midpoint of the
+    round trip, so ``offset = remote − midpoint`` and the error is
+    bounded by ``rtt/2`` (reported alongside, never hidden)."""
+    rtt = max(t_recv_wall - t_send_wall, 0.0)
+    midpoint = (t_send_wall + t_recv_wall) / 2.0
+    return {
+        "offset_s": round(remote_now_wall - midpoint, 6),
+        "rtt_s": round(rtt, 6),
+    }
+
+
+def _sink_t0_wall(events: list[dict]) -> float | None:
+    for ev in events:
+        if ev.get("kind") == "meta" and ev.get("t0_wall") is not None:
+            return float(ev["t0_wall"])
+    return None
+
+
+def merge_fleet_events(
+    sinks: dict[str, str], offsets: dict[str, float] | None = None
+) -> tuple[list[dict], dict]:
+    """Load N per-replica JSONL sinks and re-time every event onto one
+    shared timeline.
+
+    ``sinks`` maps a replica label -> its ``serving.trace_log`` path;
+    ``offsets`` maps the same labels -> the measured replica-minus-router
+    wall-clock offset in seconds (absent labels are taken at 0 — correct
+    for the router's own sink, approximate for an unpolled replica).
+
+    Each sink's events are monotonic seconds since *its* recorder epoch;
+    the meta line's ``t0_wall`` anchors that epoch to the replica's wall
+    clock, and the offset corrects the replica's wall clock to the
+    router's. The merged base is the earliest corrected epoch, so the
+    merged document starts at ts 0 like a single-sink export.
+
+    Returns ``(events, report)`` where the report carries per-replica
+    alignment evidence (t0_wall, applied offset, event count, skipped
+    sinks)."""
+    offsets = offsets or {}
+    loaded: dict[str, tuple[list[dict], float]] = {}
+    report: dict = {"replicas": {}, "skipped": {}}
+    for label, path in sorted(sinks.items()):
+        if not path or not os.path.exists(path):
+            report["skipped"][label] = "missing sink"
+            continue
+        events = read_jsonl(path)
+        t0_wall = _sink_t0_wall(events)
+        if t0_wall is None:
+            report["skipped"][label] = "no meta line (empty sink?)"
+            continue
+        loaded[label] = (events, t0_wall + float(offsets.get(label) or 0.0))
+    if not loaded:
+        return [], report
+    base = min(t0 for _, t0 in loaded.values())
+    merged: list[dict] = [{"kind": "meta", "t0_wall": round(base, 6)}]
+    for label, (events, t0_corrected) in sorted(loaded.items()):
+        shift = t0_corrected - base
+        n = 0
+        for ev in events:
+            if ev.get("kind") == "meta":
+                continue
+            ev = dict(ev)
+            ev["ts"] = round(float(ev.get("ts", 0.0)) + shift, 6)
+            # keep gauge tracks per-replica instead of one shared
+            # "gauges" pid — queue depths from two replicas are not one
+            # counter
+            if ev.get("kind") == "gauge" and "trace" not in ev:
+                ev["trace"] = f"{label}:gauges"
+            merged.append(ev)
+            n += 1
+        report["replicas"][label] = {
+            "t0_wall": round(t0_corrected, 6),
+            "offset_s": round(float(offsets.get(label) or 0.0), 6),
+            "shift_s": round(shift, 6),
+            "events": n,
+        }
+    merged[1:] = sorted(merged[1:], key=lambda e: e.get("ts", 0.0))
+    return merged, report
+
+
+def merge_fleet_traces(
+    sinks: dict[str, str],
+    offsets: dict[str, float] | None = None,
+    out_path: str | None = None,
+) -> dict:
+    """Merge per-replica sinks into one Chrome/Perfetto document (see
+    :func:`merge_fleet_events`); the alignment report lands in
+    ``otherData.fleet_merge``. With ``out_path`` the document is also
+    written to disk."""
+    events, report = merge_fleet_events(sinks, offsets)
+    doc = to_chrome_trace(events)
+    doc.setdefault("otherData", {})["fleet_merge"] = report
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(doc, fh)
+    return doc

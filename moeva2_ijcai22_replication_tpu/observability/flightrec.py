@@ -1,0 +1,125 @@
+"""Black-box flight recorder: the last N request journeys, dumpable.
+
+The chaos proof (kill-a-replica, ``serving.fleet.sweep``) showed the
+fleet *loses nothing it didn't have to* — but everything the dead replica
+had in flight vanished with it: which batch was dispatching, which
+requests rode it, what the ledger/gap/capacity windows looked like in the
+final seconds. This module is the aircraft-style flight recorder that
+survives the crash:
+
+- :class:`FlightRecorder` keeps a bounded ring of *completed* request
+  entries (id, trace id, status, latency, batch coordinates) — fed from
+  the service's done-callback, host-side dict appends only, so the
+  capture-on/off contract (zero extra compiles/dispatches, bit-identical
+  responses) holds trivially.
+- :meth:`FlightRecorder.dump` serializes the ring plus a caller-supplied
+  ``extra`` block (the service adds the batcher's in-flight view and
+  ledger/gap/capacity/shed snapshots) to
+  ``out/flight_<replica>_<reason>.json`` **atomically** (tmp +
+  ``os.replace``) — a dump interrupted by the very death it documents
+  must never leave a half-written file for the harvester.
+
+The fleet manager triggers a dump over ``POST /debug/flight`` just
+before SIGKILL (and the serve.py SIGTERM handler dumps on graceful
+drain), then harvests the path — so a chaos ``lost_dead_replica`` row is
+attributable to the exact batch it died in, not just to the dead
+replica.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["FlightRecorder", "load_flight_dump"]
+
+
+class FlightRecorder:
+    """Bounded ring of completed-request entries + atomic dump."""
+
+    def __init__(self, capacity: int = 64, clock=time.time):
+        self.capacity = int(capacity)
+        self.enabled = self.capacity > 0
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(self.capacity, 1)
+        )
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.recorded = 0
+        self.dumps = 0
+
+    def note(self, entry: dict) -> None:
+        """Append one completed-request entry (host-side, two dict ops
+        under a lock — safe on the done-callback path)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(dict(entry, t_wall=round(self._clock(), 6)))
+            self.recorded += 1
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "capacity": self.capacity,
+                "recorded": self.recorded,
+                "ring_size": len(self._ring),
+                "dumps": self.dumps,
+            }
+
+    def dump(
+        self,
+        path: str,
+        *,
+        reason: str,
+        replica_id: str | None = None,
+        extra: dict | None = None,
+    ) -> dict:
+        """Atomically write the flight dump; returns its summary (the
+        shape ``POST /debug/flight`` responds with and the fleet manager
+        stores as harvest evidence)."""
+        doc = {
+            "kind": "flight_dump",
+            "reason": reason,
+            "replica_id": replica_id,
+            "t_wall": round(self._clock(), 6),
+            "pid": os.getpid(),
+            "flight": self.snapshot(),
+            "entries": self.entries(),
+            "extra": extra or {},
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, default=str)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        with self._lock:
+            self.dumps += 1
+        return {
+            "path": path,
+            "reason": reason,
+            "replica_id": replica_id,
+            "entries": len(doc["entries"]),
+            "t_wall": doc["t_wall"],
+        }
+
+
+def load_flight_dump(path: str) -> dict | None:
+    """Read a harvested dump; None when missing/unparseable (a dump that
+    never completed is itself evidence — the caller reports the absence,
+    it must not crash on it)."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None

@@ -1,0 +1,347 @@
+"""Incident attribution: SLO-breach detection with frozen evidence.
+
+The serving stack publishes plenty of *symptoms* — p99 histograms, shed
+matrices, capacity headroom, balance ratios — but a symptom on /healthz
+names no cause: by the time an operator looks, the windowed trackers have
+rolled past the interesting seconds. An *incident* is the bridge: a
+predicate over the existing windowed trackers trips, and the detector
+**freezes the correlated evidence at that instant** (top gap stages,
+recompile causes, the shed matrix, the offending traces from the flight
+ring — whatever the owner's ``evidence_fn`` gathers) into a record that
+outlives the windows. "p99 regressed" becomes "p99 regressed because
+bucket-1024 recompiled on replica r02".
+
+Predicates (all host-side comparisons over snapshots the service already
+assembles — capture on/off stays zero extra compiles/dispatches):
+
+- ``slo_breach`` — a stage's windowed p99 exceeds ``p99_factor`` × the
+  best p99 this detector has seen for that (domain, stage), with at
+  least ``min_samples`` observations (the same 3× rule as
+  :func:`~.slo.detect_knee`, applied longitudinally instead of across a
+  load ladder).
+- ``shed_spike`` — the shed total grew by ≥ ``shed_spike_min`` since the
+  previous tick (a burst, not a trickle).
+- ``capacity_collapse`` — a domain's ``max_sustainable_qps`` fell below
+  ``capacity_collapse_ratio`` × its best observed value (a recompile
+  storm or a sick device, not load).
+- ``balance_drop`` — a balance ratio (mesh per-device, or the fleet's
+  routable fraction) fell below ``balance_drop_floor``.
+- ``replica_dead`` — opened explicitly by the fleet layer when a kill or
+  crashed poll is observed; evidence is the harvested flight dump.
+
+Dedupe/cooldown keep one incident per ongoing condition: re-trips of an
+open incident count as ``repeats``; a re-trip within ``cooldown_s`` of a
+resolve is suppressed. ``incidents_block`` renders the detector for
+/healthz, /metrics and the ``telemetry.incidents`` record block
+``records.validate_record`` requires on serving/fleet records.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+
+__all__ = [
+    "INCIDENT_KEYS",
+    "INCIDENT_KINDS",
+    "IncidentDetector",
+    "incidents_block",
+    "validate_incidents",
+]
+
+#: the keys every ``telemetry.incidents`` block carries
+INCIDENT_KEYS = ("enabled", "open", "total", "by_kind", "incidents")
+
+#: the predicate taxonomy (explicit opens may add fleet-side kinds)
+INCIDENT_KINDS = (
+    "slo_breach",
+    "shed_spike",
+    "capacity_collapse",
+    "balance_drop",
+    "replica_dead",
+)
+
+
+def _freeze(evidence) -> tuple[dict, bool]:
+    """Deep-copy evidence through JSON so later tracker mutation cannot
+    reach into an incident record; returns (evidence, frozen)."""
+    if evidence is None:
+        return {}, False
+    try:
+        return json.loads(json.dumps(evidence, default=str)), True
+    except (TypeError, ValueError):
+        return {"evidence_error": "unserializable"}, False
+
+
+class IncidentDetector:
+    """Predicate evaluation + incident records with frozen evidence."""
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        clock=time.monotonic,
+        cooldown_s: float = 60.0,
+        max_history: int = 32,
+        p99_factor: float = 3.0,
+        min_samples: int = 20,
+        shed_spike_min: int = 8,
+        capacity_collapse_ratio: float = 0.5,
+        balance_drop_floor: float = 0.5,
+    ):
+        self.enabled = bool(enabled)
+        self._clock = clock
+        self.cooldown_s = float(cooldown_s)
+        self.max_history = int(max_history)
+        self.p99_factor = float(p99_factor)
+        self.min_samples = int(min_samples)
+        self.shed_spike_min = int(shed_spike_min)
+        self.capacity_collapse_ratio = float(capacity_collapse_ratio)
+        self.balance_drop_floor = float(balance_drop_floor)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.incidents: list[dict] = []
+        self.by_kind: dict[str, int] = {}
+        self.total = 0
+        self.suppressed = 0
+        self._open: dict[str, dict] = {}  # dedupe key -> open incident
+        self._last_open_t: dict[str, float] = {}
+        # longitudinal predicate baselines
+        self._p99_best: dict[tuple, float] = {}
+        self._qps_best: dict[str, float] = {}
+        self._last_shed_total: int | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def open(
+        self,
+        kind: str,
+        summary: str,
+        *,
+        severity: str = "warning",
+        evidence: dict | None = None,
+        evidence_fn=None,
+        dedupe_key: str | None = None,
+    ) -> dict | None:
+        """Open an incident, freezing its evidence NOW. An already-open
+        incident under the same dedupe key absorbs the re-trip as a
+        ``repeats`` bump; a re-trip inside the cooldown window after a
+        resolve is suppressed (counted, not recorded)."""
+        if not self.enabled:
+            return None
+        key = dedupe_key or kind
+        now = self._clock()
+        with self._lock:
+            existing = self._open.get(key)
+            if existing is not None:
+                existing["repeats"] += 1
+                self.suppressed += 1
+                return existing
+            last = self._last_open_t.get(key)
+            if last is not None and now - last < self.cooldown_s:
+                self.suppressed += 1
+                return None
+        if evidence is None and evidence_fn is not None:
+            try:
+                evidence = evidence_fn()
+            except Exception as e:  # noqa: BLE001 — evidence must not kill
+                evidence = {"evidence_error": repr(e)}
+        frozen_ev, frozen = _freeze(evidence)
+        if "evidence_error" in frozen_ev:
+            frozen = False
+        inc = {
+            "id": next(self._ids),
+            "kind": kind,
+            "key": key,
+            "severity": severity,
+            "state": "open",
+            "t_open": round(now, 3),
+            "summary": summary,
+            "frozen": frozen,
+            "evidence": frozen_ev,
+            "repeats": 0,
+        }
+        with self._lock:
+            self.incidents.append(inc)
+            del self.incidents[: -self.max_history]
+            self._open[key] = inc
+            self._last_open_t[key] = now
+            self.total += 1
+            self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+        return inc
+
+    def resolve(self, dedupe_key: str, note: str | None = None) -> dict | None:
+        with self._lock:
+            inc = self._open.pop(dedupe_key, None)
+        if inc is not None:
+            inc["state"] = "resolved"
+            inc["t_resolve"] = round(self._clock(), 3)
+            if note:
+                inc["resolve_note"] = note
+        return inc
+
+    def resolve_all(self, note: str | None = None) -> int:
+        with self._lock:
+            keys = list(self._open)
+        return sum(1 for k in keys if self.resolve(k, note) is not None)
+
+    # -- predicate tick ------------------------------------------------------
+    def tick(
+        self,
+        *,
+        slo: dict | None = None,
+        capacity: dict | None = None,
+        balance_ratio: float | None = None,
+        balance_label: str = "balance",
+        evidence_fn=None,
+    ) -> list[dict]:
+        """One predicate pass over the snapshots the caller already has
+        (nothing here re-reads trackers, so the caller controls the
+        window). Returns the incidents opened this tick; resolves open
+        incidents whose condition cleared."""
+        if not self.enabled:
+            return []
+        opened: list[dict] = []
+
+        def trip(kind, key, summary, measured):
+            ev = None
+            if evidence_fn is not None:
+                try:
+                    ev = evidence_fn()
+                except Exception as e:  # noqa: BLE001
+                    ev = {"evidence_error": repr(e)}
+            ev = dict(ev or {}, trigger=measured)
+            inc = self.open(kind, summary, evidence=ev, dedupe_key=key)
+            if inc is not None and inc.get("state") == "open" and not inc["repeats"]:
+                opened.append(inc)
+
+        # -- slo_breach: windowed p99 vs best-seen, per (domain, stage) ------
+        for domain, by_stage in ((slo or {}).get("stages") or {}).items():
+            for stage, snap in (by_stage or {}).items():
+                p99 = (snap or {}).get("p99")
+                n = (snap or {}).get("n") or 0
+                if p99 is None or n < self.min_samples:
+                    continue
+                key = f"slo_breach:{domain}:{stage}"
+                best = self._p99_best.get((domain, stage))
+                if best is not None and p99 > self.p99_factor * best:
+                    trip(
+                        "slo_breach",
+                        key,
+                        f"{domain}/{stage} p99 {p99 * 1e3:.1f}ms > "
+                        f"{self.p99_factor:g}x best {best * 1e3:.1f}ms",
+                        {
+                            "domain": domain,
+                            "stage": stage,
+                            "p99_s": p99,
+                            "baseline_p99_s": best,
+                            "n": n,
+                        },
+                    )
+                else:
+                    self.resolve(key, "p99 back under factor")
+                    self._p99_best[(domain, stage)] = (
+                        p99 if best is None else min(best, p99)
+                    )
+        # -- shed_spike: shed-total delta since the previous tick ------------
+        shed_total = ((slo or {}).get("shed") or {}).get("total")
+        if isinstance(shed_total, int):
+            last = self._last_shed_total
+            if last is not None and shed_total - last >= self.shed_spike_min:
+                trip(
+                    "shed_spike",
+                    "shed_spike",
+                    f"shed {shed_total - last} requests since last tick "
+                    f"(>= {self.shed_spike_min})",
+                    {"shed_delta": shed_total - last, "shed_total": shed_total},
+                )
+            else:
+                self.resolve("shed_spike", "shed rate back to normal")
+            self._last_shed_total = shed_total
+        # -- capacity_collapse: max_sustainable_qps vs best-seen, per domain -
+        for domain, d in ((capacity or {}).get("by_domain") or {}).items():
+            qps = (d or {}).get("max_sustainable_qps")
+            if not qps:
+                continue
+            key = f"capacity_collapse:{domain}"
+            best = self._qps_best.get(domain)
+            if best and qps < self.capacity_collapse_ratio * best:
+                trip(
+                    "capacity_collapse",
+                    key,
+                    f"{domain} max_sustainable_qps {qps:.1f} < "
+                    f"{self.capacity_collapse_ratio:g}x best {best:.1f}",
+                    {"domain": domain, "qps": qps, "best_qps": best},
+                )
+            else:
+                self.resolve(key, "capacity recovered")
+                self._qps_best[domain] = max(best or 0.0, float(qps))
+        # -- balance_drop: caller-supplied ratio under the floor -------------
+        if balance_ratio is not None:
+            key = f"balance_drop:{balance_label}"
+            if balance_ratio < self.balance_drop_floor:
+                trip(
+                    "balance_drop",
+                    key,
+                    f"{balance_label} ratio {balance_ratio:.3f} < floor "
+                    f"{self.balance_drop_floor:g}",
+                    {"label": balance_label, "ratio": balance_ratio},
+                )
+            else:
+                self.resolve(key, "balance recovered")
+        return opened
+
+    # -- views ---------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "open": len(self._open),
+                "total": self.total,
+                "suppressed": self.suppressed,
+                "by_kind": dict(self.by_kind),
+                "incidents": [dict(i) for i in self.incidents],
+            }
+
+
+def incidents_block(detector: IncidentDetector | None) -> dict:
+    """The ``telemetry.incidents`` block: the detector's snapshot, or an
+    honest capture-off block when detection is disabled/absent."""
+    if detector is None or not detector.enabled:
+        return {
+            "enabled": False,
+            "open": 0,
+            "total": 0,
+            "by_kind": {},
+            "incidents": [],
+        }
+    return detector.snapshot()
+
+
+def validate_incidents(block: dict, kind: str = "record") -> dict:
+    """Schema check for a ``telemetry.incidents`` block; returns it."""
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} record's telemetry.incidents must be a dict "
+            "(assemble it with observability.incidents.incidents_block)"
+        )
+    missing = [k for k in INCIDENT_KEYS if k not in block]
+    if missing:
+        raise ValueError(
+            f"{kind} record's telemetry.incidents block is missing keys "
+            f"{missing}: every incidents block carries {list(INCIDENT_KEYS)}"
+        )
+    for inc in block.get("incidents") or []:
+        inc_missing = [
+            k
+            for k in ("id", "kind", "state", "t_open", "summary", "frozen")
+            if k not in inc
+        ]
+        if inc_missing:
+            raise ValueError(
+                f"{kind} record has an incident missing {inc_missing} — "
+                "incidents must be opened through IncidentDetector.open "
+                "so their evidence is frozen at open time"
+            )
+    return block

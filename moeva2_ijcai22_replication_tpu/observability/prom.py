@@ -105,6 +105,11 @@ _HELP = {
     "coldstart_time_to_first_dispatch_s": "Seconds from package import to the first compiled-program dispatch",
     "coldstart_executables": "Executables classified by cold source (aot_hit, hit, aot_stored, miss_stored, miss_uncached, fallback, disabled, unknown)",
     "coldstart_aot_load_failures": "Serialized-executable cache entries rejected at load (corrupt, fingerprint-stale, or undeserializable) — each fell back to a fresh compile",
+    "incidents_open": "Incidents currently open (SLO-breach/shed-spike/capacity-collapse/balance-drop predicates with frozen evidence)",
+    "incidents_total": "Incidents ever opened by the detector, by predicate kind",
+    "incidents_suppressed": "Incident re-trips absorbed by dedupe/cooldown (repeats of an open incident or re-trips inside the cooldown window)",
+    "flight_ring_entries": "Completed-request entries currently held by the black-box flight recorder ring",
+    "flight_dumps": "Flight-recorder dumps written (POST /debug/flight, SIGTERM drain, pre-kill harvest)",
 }
 
 
@@ -584,6 +589,47 @@ def _qos_lines(prefix: str, block: dict, lines: list[str]) -> None:
             lines.append(f'{n}{{class="{_escape_label(klass)}"}} {_fmt(v)}')
 
 
+def _incidents_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Incident exposition: the open-count gauge an operator alerts on,
+    the ``{kind}``-labeled lifetime counter, and the dedupe-suppression
+    counter (how much noise the cooldown absorbed)."""
+    if block.get("enabled") is False:
+        return
+    v = block.get("open")
+    if isinstance(v, int):
+        n = _name(prefix, "incidents_open")
+        _family(lines, n, "gauge", "incidents_open")
+        lines.append(f"{n} {_fmt(v)}")
+    by_kind = block.get("by_kind") or {}
+    rows = [(k, v) for k, v in sorted(by_kind.items()) if isinstance(v, int)]
+    if rows:
+        n = _name(prefix, "incidents", "_total")
+        _family(lines, n, "counter", "incidents_total")
+        for kind, v in rows:
+            lines.append(f'{n}{{kind="{_escape_label(kind)}"}} {_fmt(v)}')
+    v = block.get("suppressed")
+    if isinstance(v, int):
+        n = _name(prefix, "incidents_suppressed", "_total")
+        _family(lines, n, "counter", "incidents_suppressed")
+        lines.append(f"{n} {_fmt(v)}")
+
+
+def _flight_lines(prefix: str, block: dict, lines: list[str]) -> None:
+    """Flight-recorder exposition: ring occupancy + dump counter."""
+    if block.get("enabled") is False:
+        return
+    v = block.get("ring_size")
+    if isinstance(v, int):
+        n = _name(prefix, "flight_ring_entries")
+        _family(lines, n, "gauge", "flight_ring_entries")
+        lines.append(f"{n} {_fmt(v)}")
+    v = block.get("dumps")
+    if isinstance(v, int):
+        n = _name(prefix, "flight_dumps", "_total")
+        _family(lines, n, "counter", "flight_dumps")
+        lines.append(f"{n} {_fmt(v)}")
+
+
 def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     """ServiceMetrics snapshot dict -> Prometheus exposition text."""
     lines: list[str] = []
@@ -612,6 +658,12 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
     qos = snapshot.get("qos")
     if isinstance(qos, dict):
         _qos_lines(prefix, qos, lines)
+    incidents = snapshot.get("incidents")
+    if isinstance(incidents, dict):
+        _incidents_lines(prefix, incidents, lines)
+    flight = snapshot.get("flight")
+    if isinstance(flight, dict):
+        _flight_lines(prefix, flight, lines)
 
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _name(prefix, name, "_total")
@@ -644,6 +696,7 @@ def prometheus_text(snapshot: dict, prefix: str = "moeva2") -> str:
         if key in (
             "counters", "gauges", "streams", "cost_ledger", "quality",
             "slo", "capacity", "mesh", "gaps", "coldstart", "qos",
+            "incidents", "flight",
         ):
             continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):

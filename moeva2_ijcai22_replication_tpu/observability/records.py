@@ -18,6 +18,7 @@ from .gaps import (
     spans_from_trace,
     validate_gaps,
 )
+from .incidents import incidents_block, validate_incidents
 from .ledger import get_ledger
 from .mesh import mesh_block, validate_mesh
 from .quality import quality_block, validate_quality
@@ -46,6 +47,7 @@ def telemetry_block(
     mesh: dict | None = None,
     mesh_since: dict | None = None,
     gaps_since: dict | None = None,
+    incidents: dict | None = None,
 ) -> dict:
     """JSON-ready telemetry summary for a record: span totals (from a
     PhaseTimer), trace id + event count (from a Trace), recorder counters,
@@ -102,6 +104,12 @@ def telemetry_block(
     )
     if slo is not None:
         block["slo"] = validate_slo(slo)
+    # ``incidents`` is a pre-assembled ``incidents.incidents_block``
+    # (predicate trips with frozen evidence) — serving/fleet producers
+    # pass it (``validate_record`` enforces it on those kinds); batch
+    # producers have no detector loop and omit it
+    if incidents is not None:
+        block["incidents"] = validate_incidents(incidents)
     if timer is not None:
         block["spans_s"] = {k: round(v, 4) for k, v in timer.spans.items()}
         block["span_total_s"] = round(sum(timer.spans.values()), 4)
@@ -207,6 +215,20 @@ def validate_record(record: dict, kind: str = "record") -> dict:
                 "number"
             )
         validate_slo(telemetry["slo"], kind)
+    # serving AND fleet records additionally carry the incidents block —
+    # the request/fleet paths run the incident detector, and a record
+    # without it would let an SLO breach ship unattributed (exactly the
+    # blindness the bench_diff --incidents gate exists to close)
+    if kind in ("serving", "fleet"):
+        if "incidents" not in telemetry:
+            raise ValueError(
+                f"{kind} record's telemetry block is missing the "
+                "'incidents' sub-block: assemble it with "
+                "observability.incidents.incidents_block so SLO-breach "
+                "attribution (frozen evidence) travels with every "
+                "committed serving/fleet number"
+            )
+        validate_incidents(telemetry["incidents"], kind)
     return record
 
 

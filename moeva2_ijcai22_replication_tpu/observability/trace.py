@@ -174,6 +174,7 @@ class Trace:
         name: str = "",
         record: bool = True,
         enabled: bool | None = None,
+        root_parent: int | None = None,
     ):
         self.recorder = recorder
         self.id = trace_id or uuid.uuid4().hex[:12]
@@ -181,6 +182,12 @@ class Trace:
         self.events: list[dict] = []
         self.record = record
         self.enabled = recorder.spans_enabled if enabled is None else bool(enabled)
+        # cross-process parenting (fleet trace propagation): root spans of
+        # this trace parent under a REMOTE span id (the router's attempt
+        # span, carried in by the X-Moeva2-Trace header). Local ``tree()``
+        # rendering is unaffected — an unknown parent renders as a root —
+        # but a merged fleet document nests this trace under its hop.
+        self.root_parent = root_parent
         # span parentage is per-thread: a trace may be touched from several
         # threads (submit on a handler thread, dispatch on the flusher) and
         # their span stacks must not interleave
@@ -195,7 +202,7 @@ class Trace:
 
     def _parent(self):
         stack = getattr(self._tls, "stack", ())
-        return stack[-1] if stack else None
+        return stack[-1] if stack else self.root_parent
 
     # -- spans ---------------------------------------------------------------
     @contextlib.contextmanager
@@ -211,7 +218,7 @@ class Trace:
             return
         sid = next(_span_ids)
         stack = getattr(self._tls, "stack", ())
-        parent = stack[-1] if stack else None
+        parent = stack[-1] if stack else self.root_parent
         self._tls.stack = stack + (sid,)
         t0 = self.recorder.now()
         try:
@@ -279,6 +286,8 @@ class Trace:
         ``parent``). Span ids are process-unique, so no remapping needed."""
         if not self.enabled:
             return
+        if parent is None:
+            parent = self.root_parent
         for ev in other.events:
             ev = dict(ev, trace=self.id)
             if ev.get("parent") is None and parent is not None:

@@ -171,6 +171,9 @@ class Microbatcher:
         self._queues: dict[Any, _KeyQueue] = {}
         self._rows_total = 0
         self._batch_seq = 0
+        #: the batch currently on the device (None between dispatches) —
+        #: the flight recorder's "what died in flight" evidence
+        self._inflight: dict | None = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # engines are single-dispatch objects (host-side knobs are mutated
@@ -505,6 +508,26 @@ class Microbatcher:
         router = self._partial_router(batch)
         if router is not None:
             ctx["partial_router"] = router
+        # publish the in-flight view BEFORE the dispatch: a flight dump
+        # taken while this batch executes (the replica is being killed)
+        # names the exact batch and riders that died on the device
+        inflight = {
+            "batch_seq": seq,
+            "bucket": int(bucket),
+            "rows": int(rows_total),
+            "requests": [
+                {
+                    "request_id": p.meta.get("request_id"),
+                    "domain": p.meta.get("domain"),
+                    "rows": p.n,
+                    "trace_id": p.trace.id if p.trace is not None else None,
+                }
+                for p in batch
+            ],
+            "t_start": round(self.clock(), 6),
+        }
+        with self._lock:
+            self._inflight = inflight
         t0 = self.clock()
         try:
             with ledger_context(**ctx):
@@ -524,6 +547,9 @@ class Microbatcher:
                     f"expected bucket size {bucket}"
                 )
         except BaseException as e:  # noqa: BLE001 — isolation boundary
+            with self._lock:
+                if self._inflight is inflight:
+                    self._inflight = None
             if self.metrics:
                 self.metrics.count("batch_failures")
             err = BatchExecutionError(key, e)
@@ -539,6 +565,9 @@ class Microbatcher:
                     p.trace.event("batch_failed", batch_seq=seq, error=repr(e))
                 p.future.set_exception(err)
             return
+        with self._lock:
+            if self._inflight is inflight:
+                self._inflight = None
         dt = self.clock() - t0
         occupancy = rows_total / bucket
         if self.metrics:
@@ -649,6 +678,35 @@ class Microbatcher:
     def queue_depth_rows(self) -> int:
         with self._lock:
             return self._rows_total
+
+    def inflight_view(self) -> dict:
+        """What the batcher holds RIGHT NOW: every queued request (id,
+        domain, rows, class) plus the batch currently executing on the
+        device — the flight dump's in-flight evidence, so a kill mid-
+        dispatch stays attributable to the exact batch and riders."""
+        with self._lock:
+            queued = [
+                {
+                    "request_id": p.meta.get("request_id"),
+                    "domain": p.meta.get("domain"),
+                    "rows": p.n,
+                    "qos_class": p.qos_class,
+                }
+                for q in self._queues.values()
+                for p in (
+                    [p for dq in q.by_class.values() for p in dq]
+                    if q.by_class is not None
+                    else list(q.requests)
+                )
+            ]
+            return {
+                "queued_rows": self._rows_total,
+                "queued": queued,
+                "dispatching": (
+                    dict(self._inflight) if self._inflight else None
+                ),
+                "batch_seq": self._batch_seq,
+            }
 
     def stop(self, drain: bool = True):
         """Stop the flusher; with ``drain``, flush whatever is queued first

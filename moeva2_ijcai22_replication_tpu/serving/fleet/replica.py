@@ -48,11 +48,14 @@ import time
 import urllib.request
 from typing import Callable
 
+from ...observability.fleetrace import clock_offset
+
 __all__ = [
     "BuildMismatch",
     "ReplicaHandle",
     "ReplicaManager",
     "default_http_get",
+    "default_http_post_json",
 ]
 
 #: replica lifecycle states (the admit/drain state machine)
@@ -75,6 +78,20 @@ class BuildMismatch(RuntimeError):
 def default_http_get(url: str, timeout_s: float = 5.0) -> dict:
     """GET ``url`` and parse the JSON body (the injectable default)."""
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def default_http_post_json(
+    url: str, payload: dict, timeout_s: float = 5.0
+) -> dict:
+    """POST ``payload`` as JSON and parse the JSON reply — the manager's
+    control-plane POST (flight-dump harvest), injectable like http_get."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         return json.loads(resp.read())
 
 
@@ -103,6 +120,17 @@ class ReplicaHandle:
         self.last_health: dict | None = None
         self.fingerprint: tuple | None = None
         self.poll_errors = 0
+        #: the LAST poll failure, not just its count — the first question
+        #: in any incident is "what did the dead replica last say?"
+        self.last_poll_error: dict | None = None  # {"error", "t_wall"}
+        #: router↔replica clock offset (fleetrace.clock_offset, measured
+        #: against healthz ``now_wall`` at poll time) — what the fleet
+        #: trace merge uses to align this replica's Perfetto track
+        self.clock_offset_s: float | None = None
+        self.clock_rtt_s: float | None = None
+        #: harvested flight-dump summary ({"path", "entries", ...}) from
+        #: the pre-kill POST /debug/flight — the chaos black box
+        self.flight_dump: dict | None = None
 
     # -- derived views -------------------------------------------------------
     def capacity_qps(self) -> float | None:
@@ -156,6 +184,8 @@ class ReplicaHandle:
                 else None
             ),
             "poll_errors": self.poll_errors,
+            "last_poll_error": self.last_poll_error,
+            "clock_offset_s": self.clock_offset_s,
             "capacity_qps": self.capacity_qps(),
             "capacity_age_s": self.capacity_age_s(),
             "headroom": self.headroom(),
@@ -196,8 +226,10 @@ class ReplicaManager:
         *,
         spawn_fn: Callable[[str], ReplicaHandle] | None = None,
         http_get: Callable[[str], dict] = default_http_get,
+        http_post: Callable[[str, dict], dict] = default_http_post_json,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        wall: Callable[[], float] = time.time,
         log_dir: str | None = None,
         python: str = sys.executable,
         prewarm: bool = True,
@@ -210,8 +242,13 @@ class ReplicaManager:
         self.config_path = config_path
         self.spawn_fn = spawn_fn
         self.http_get = http_get
+        self.http_post = http_post
         self.clock = clock
         self.sleep = sleep
+        # wall is the SHARED epoch clock for the clock-offset handshake
+        # (manager.clock is often a fake monotonic in tests — offsets
+        # must not mix the two domains)
+        self.wall = wall
         self.log_dir = log_dir
         self.python = python
         self.prewarm = prewarm
@@ -364,6 +401,10 @@ class ReplicaManager:
             except Exception as e:  # noqa: BLE001 — booting replica
                 last_err = e
                 handle.poll_errors += 1
+                handle.last_poll_error = {
+                    "error": repr(e),
+                    "t_wall": round(self.wall(), 3),
+                }
                 if handle.proc is not None and handle.proc.poll() is not None:
                     handle.state = "dead"
                     raise RuntimeError(
@@ -433,16 +474,31 @@ class ReplicaManager:
         for handle in self.replicas():
             if handle.state not in ("admitted", "draining"):
                 continue
+            t_send = self.wall()
             try:
                 health = self.http_get(handle.url + "/healthz")
-            except Exception:  # noqa: BLE001 — poll failure is a state
+            except Exception as e:  # noqa: BLE001 — poll failure is a state
                 handle.poll_errors += 1
+                handle.last_poll_error = {
+                    "error": repr(e),
+                    "t_wall": round(self.wall(), 3),
+                }
                 if handle.proc is not None and handle.proc.poll() is not None:
                     handle.state = "dead"
                 continue
+            t_recv = self.wall()
             if health.get("ok"):
                 handle.last_poll_t = now
                 handle.last_health = health
+                # clock-offset handshake: the replica stamps its own wall
+                # clock (``now_wall``) into every healthz; the NTP midpoint
+                # rule against our send/recv wall times gives the offset the
+                # fleet trace merge uses to align this replica's track
+                remote_wall = health.get("now_wall")
+                if remote_wall is not None:
+                    off = clock_offset(t_send, t_recv, float(remote_wall))
+                    handle.clock_offset_s = off["offset_s"]
+                    handle.clock_rtt_s = off["rtt_s"]
         return self.fleet_view()
 
     def fleet_view(self) -> dict:
@@ -526,9 +582,26 @@ class ReplicaManager:
     def kill(self, replica_id: str) -> dict:
         """SIGKILL, no grace — the chaos path. In-flight requests on this
         replica die with it; the fleet sweep's shed accounting proves the
-        router loses nothing else."""
+        router loses nothing else.
+
+        Before the signal, the manager harvests the replica's black box
+        (best-effort ``POST /debug/flight``): SIGKILL leaves no moment to
+        dump, so the flight recorder's last complete journeys + the
+        in-flight batch view are captured from outside, one RPC ahead of
+        the kill. A replica too wedged to answer yields ``flight: None`` —
+        the accounting then says so instead of pretending."""
         handle = self.get(replica_id)
         in_flight = handle.in_flight
+        flight = None
+        if handle.url:
+            try:
+                flight = self.http_post(
+                    handle.url + "/debug/flight",
+                    {"reason": f"chaos_kill_{replica_id}"},
+                )
+            except Exception:  # noqa: BLE001 — wedged replica: no dump
+                flight = None
+        handle.flight_dump = flight
         if handle.proc is not None and handle.proc.poll() is None:
             handle.proc.kill()
             handle.proc.wait(timeout=15)
@@ -537,6 +610,7 @@ class ReplicaManager:
             "replica_id": replica_id,
             "in_flight_at_kill": in_flight,
             "pid": getattr(handle.proc, "pid", None),
+            "flight": flight,
         }
 
     def close(self) -> None:

@@ -43,10 +43,17 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 from urllib.parse import parse_qs, urlsplit
 
+from ...observability import Trace, incidents_block, maybe_span
+from ...observability.fleetrace import (
+    TRACE_HEADER,
+    format_trace_context,
+    parse_trace_context,
+)
 from ...observability.prom import _family, _fmt, _name, _slo_lines
 from ...observability.slo import merge_slo_snapshots
 from .replica import ReplicaHandle, ReplicaManager
@@ -114,9 +121,22 @@ class Router:
         http_post: Callable[..., tuple[int, dict, bytes]] = default_http_post,
         http_get_raw: Callable[..., tuple[int, dict, bytes]] = default_http_get_raw,
         clock: Callable[[], float] = time.monotonic,
+        recorder=None,
+        incidents=None,
     ):
         self.manager = manager
         self.http_get_raw = http_get_raw
+        #: router-side trace recorder (``observability.TraceRecorder`` with
+        #: a sink, or None): when its spans are enabled every routed
+        #: request gets rank/attempt/failover spans under the SAME trace
+        #: id the replica adopts — the router half of the merged fleet
+        #: trace. None/off = zero trace work, the capture contract.
+        self.recorder = recorder
+        #: fleet-level incident detector (``observability.
+        #: IncidentDetector`` or None) — surfaced on fleet /healthz so an
+        #: operator polling the router sees open incidents with frozen
+        #: evidence without walking per-replica endpoints
+        self.incidents = incidents
         self.retry_budget = int(retry_budget)
         self.stale_after_s = float(stale_after_s)
         self.capacity_age_max_s = float(capacity_age_max_s)
@@ -182,6 +202,7 @@ class Router:
         *,
         path: str = "/attack",
         req_headers: dict | None = None,
+        trace_context: dict | None = None,
     ) -> tuple[int, dict, bytes]:
         """Forward one /attack body; returns ``(status, headers, body)``.
         Headers include ``X-Served-By`` (the replica that produced the
@@ -192,8 +213,36 @@ class Router:
         that stays incremental through the router). ``req_headers``
         forwards end-to-end request headers — the QoS class rides
         ``X-Qos-Class`` so per-class accounting on the replica matches
-        what the client asked the fleet for."""
+        what the client asked the fleet for.
+
+        Distributed tracing: EVERY forwarded attempt (first try and
+        failovers alike) is stamped with ``X-Moeva2-Trace`` — one trace
+        id per routed request (adopted from ``trace_context`` when an
+        upstream hop minted it), the attempt span's id as the remote
+        parent, and an incremented hop count — so the replica's
+        validate→queue→batch→device tree composes under the router's
+        attempt span in a merged fleet document. Successful responses
+        additionally gain ``meta.route``: the per-attempt
+        ``(replica, status, cause, elapsed_s)`` detail, hop count, and
+        trace id."""
+        ctx = trace_context or {}
+        hop_in = int(ctx.get("hop") or 0)
+        trace_id = ctx.get("trace_id") or f"fleet-{uuid.uuid4().hex[:12]}"
+        rt = (
+            Trace(
+                self.recorder,
+                trace_id=trace_id,
+                name="fleet-route",
+                root_parent=ctx.get("parent_span"),
+            )
+            if self.recorder is not None and self.recorder.spans_enabled
+            else None
+        )
         order = self.candidates()
+        if rt is not None:
+            rt.event(
+                "rank", candidates=[h.replica_id for h in order[:8]]
+            )
         if not order:
             self._count("shed_no_replica")
             return (
@@ -202,6 +251,7 @@ class Router:
                 json.dumps({"error": "no routable replica"}).encode(),
             )
         attempts = 0
+        detail: list[dict] = []
         last: tuple[int, dict, bytes] | None = None
         last_rid = None
         for handle in order[: self.retry_budget + 1]:
@@ -209,49 +259,130 @@ class Router:
             if attempts > 1:
                 self._count("retries")
             self.manager.note_inflight(handle.replica_id, +1)
-            try:
-                # extra kwargs only when needed: injected test doubles
-                # predating the QoS header keep their 3-arg signature
-                kw = {"headers": req_headers} if req_headers else {}
-                status, headers, resp_body = self.http_post(
-                    handle.url + path,
-                    body,
-                    timeout_s=self.request_timeout_s,
-                    **kw,
+            t_att = self.clock()
+            with maybe_span(
+                rt, "attempt", replica=handle.replica_id, n=attempts
+            ) as sid:
+                hdrs = dict(req_headers or {})
+                hdrs[TRACE_HEADER] = format_trace_context(
+                    trace_id, parent_span=sid, hop=hop_in + 1
                 )
-            except Exception:  # noqa: BLE001 — connection-level failure
-                # dead/unreachable replica: the chaos path. Count the
-                # cause and try the next-best candidate
-                self._count(f"failover_connection:{handle.replica_id}")
-                self._count("failover_connection_total")
-                last = (
-                    502,
-                    {},
-                    json.dumps(
+                try:
+                    status, headers, resp_body = self.http_post(
+                        handle.url + path,
+                        body,
+                        timeout_s=self.request_timeout_s,
+                        headers=hdrs,
+                    )
+                except Exception:  # noqa: BLE001 — connection-level failure
+                    # dead/unreachable replica: the chaos path. Count the
+                    # cause and try the next-best candidate
+                    self._count(f"failover_connection:{handle.replica_id}")
+                    self._count("failover_connection_total")
+                    detail.append(
                         {
-                            "error": "replica connection failed",
-                            "replica_id": handle.replica_id,
+                            "replica": handle.replica_id,
+                            "status": None,
+                            "cause": "connection",
+                            "elapsed_s": round(self.clock() - t_att, 6),
                         }
-                    ).encode(),
-                )
+                    )
+                    if rt is not None:
+                        rt.event(
+                            "failover",
+                            cause="connection",
+                            replica=handle.replica_id,
+                        )
+                    last = (
+                        502,
+                        {},
+                        json.dumps(
+                            {
+                                "error": "replica connection failed",
+                                "replica_id": handle.replica_id,
+                            }
+                        ).encode(),
+                    )
+                    last_rid = handle.replica_id
+                    continue
+                finally:
+                    self.manager.note_inflight(handle.replica_id, -1)
+                last = (status, headers, resp_body)
                 last_rid = handle.replica_id
-                continue
-            finally:
-                self.manager.note_inflight(handle.replica_id, -1)
-            last = (status, headers, resp_body)
-            last_rid = handle.replica_id
-            if status in RETRYABLE_STATUSES:
-                cause = "rejected" if status == 429 else "failed"
-                self._count(f"failover_{cause}:{handle.replica_id}")
-                self._count(f"failover_{cause}_total")
-                continue
-            # success, or a non-retryable client/deadline error: done
-            self._count("forwards")
-            return self._stamp(last, last_rid, attempts)
+                if status in RETRYABLE_STATUSES:
+                    cause = "rejected" if status == 429 else "failed"
+                    self._count(f"failover_{cause}:{handle.replica_id}")
+                    self._count(f"failover_{cause}_total")
+                    detail.append(
+                        {
+                            "replica": handle.replica_id,
+                            "status": int(status),
+                            "cause": cause,
+                            "elapsed_s": round(self.clock() - t_att, 6),
+                        }
+                    )
+                    if rt is not None:
+                        rt.event(
+                            "failover",
+                            cause=cause,
+                            status=int(status),
+                            replica=handle.replica_id,
+                        )
+                    continue
+                # success, or a non-retryable client/deadline error: done
+                detail.append(
+                    {
+                        "replica": handle.replica_id,
+                        "status": int(status),
+                        "cause": "served" if status < 400 else "terminal",
+                        "elapsed_s": round(self.clock() - t_att, 6),
+                    }
+                )
+                self._count("forwards")
+                if status < 400:
+                    # per-replica served counter: the balance-drop
+                    # incident predicate's input (a replica that stops
+                    # pulling its share shows up here first)
+                    self._count(f"served:{handle.replica_id}")
+                return self._stamp(
+                    self._inject_route_meta(
+                        last, detail, trace_id, hop_in + 1
+                    ),
+                    last_rid,
+                    attempts,
+                )
         # budget exhausted: surface the last upstream answer honestly (a
         # final 429's Retry-After flows through to the client)
         self._count("shed_budget_exhausted")
         return self._stamp(last, last_rid, attempts)
+
+    @staticmethod
+    def _inject_route_meta(
+        result: tuple[int, dict, bytes],
+        detail: list[dict],
+        trace_id: str,
+        hops: int,
+    ) -> tuple[int, dict, bytes]:
+        """Rewrite a successful single-document JSON response so its
+        ``meta`` carries the routing story (per-attempt detail, hop
+        count, trace id). Buffered ndjson streams, 202 poll tickets, and
+        error bodies pass through untouched — only a 200 whose body is a
+        dict with a ``meta`` dict is rewritten."""
+        status, headers, body = result
+        if status != 200:
+            return result
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return result
+        if not (isinstance(doc, dict) and isinstance(doc.get("meta"), dict)):
+            return result
+        doc["meta"]["route"] = {
+            "attempts": detail,
+            "hops": hops,
+            "trace_id": trace_id,
+        }
+        return status, headers, json.dumps(doc).encode()
 
     @staticmethod
     def _stamp(
@@ -307,10 +438,46 @@ class Router:
         return self._stamp(last, last_rid, attempts)
 
     # -- aggregated views -----------------------------------------------------
+    def served_balance(self) -> dict | None:
+        """Mean/max served-request balance across routable replicas
+        (1.0 = perfectly balanced). Zero-served routable replicas count —
+        a replica that silently stops pulling its share IS the signal.
+        None while unprimed (< 2 routable replicas, or no served traffic
+        yet) — the predicate arms itself from measurement, the same
+        discipline as admission and the bench gates."""
+        routable = self.manager.routable()
+        if len(routable) < 2:
+            return None
+        with self._lock:
+            served = {
+                h.replica_id: int(
+                    self.counters.get(f"served:{h.replica_id}", 0)
+                )
+                for h in routable
+            }
+        top = max(served.values())
+        if top == 0:
+            return None
+        ratio = (sum(served.values()) / len(served)) / top
+        return {"ratio": round(ratio, 4), "served": served}
+
     def healthz(self) -> dict:
         """Fleet-aggregated health: the manager's fleet view, per-replica
-        health blocks (last poll), and router counters."""
+        health blocks (last poll), and router counters. Also the
+        balance-drop incident predicate's tick point: /healthz is the
+        fleet's heartbeat, so balance is re-measured exactly as often as
+        an operator (or the poll loop) looks."""
         view = self.manager.fleet_view()
+        balance = self.served_balance()
+        if self.incidents is not None and balance is not None:
+            self.incidents.tick(
+                balance_ratio=balance["ratio"],
+                balance_label="fleet_served",
+                evidence_fn=lambda: {
+                    "served": balance["served"],
+                    "fleet": view,
+                },
+            )
         return {
             "ok": view["routable"] > 0,
             "fleet": view,
@@ -319,7 +486,11 @@ class Router:
                 "stale_after_s": self.stale_after_s,
                 "capacity_age_max_s": self.capacity_age_max_s,
                 "counters": self.counters_snapshot(),
+                "served_balance": balance,
             },
+            # fleet-level incident attribution: open/total incidents with
+            # frozen evidence, right where an operator looks first
+            "incidents": incidents_block(self.incidents),
             "replicas": {
                 h.replica_id: h.last_health
                 for h in self.manager.replicas()
@@ -447,8 +618,11 @@ class RouterHTTPHandler(BaseHTTPRequestHandler):
         qos_class = self.headers.get("X-Qos-Class")
         if qos_class:
             fwd["X-Qos-Class"] = qos_class
+        # an upstream hop (another router, a test harness) may have minted
+        # the trace already — adopt it so the id survives the extra hop
+        trace_ctx = parse_trace_context(self.headers.get(TRACE_HEADER))
         status, headers, resp_body = self.server.router.route(
-            body, path=self.path, req_headers=fwd
+            body, path=self.path, req_headers=fwd, trace_context=trace_ctx
         )
         self._send(status, resp_body, headers, "application/json")
 

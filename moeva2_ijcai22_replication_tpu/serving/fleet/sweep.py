@@ -53,9 +53,12 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
 
 from ...observability import (
+    IncidentDetector,
     detect_knee,
     get_gap_tracker,
     get_ledger,
+    incidents_block,
+    load_flight_dump,
     telemetry_block,
     validate_record,
 )
@@ -205,6 +208,66 @@ def _warm_evidence(manager: ReplicaManager, exclude=()) -> dict:
     }
 
 
+def _attribute_losses(harvest: dict | None, lost_ids: list[str]) -> dict:
+    """Join the chaos level's lost request ids against the flight dump
+    harvested from the victim just before SIGKILL: every lost row should
+    name the exact place it died — riding the batch that was ON the
+    device (``dispatching``, with its batch seq), waiting in the queue
+    (``queued``), or already completed on the replica with the response
+    lost on the wire (``completed``, in the flight ring). Ids the dump
+    never saw stay ``untracked`` — the gate's honesty bound."""
+    block: dict = {
+        "harvested": bool(harvest and harvest.get("path")),
+        "dump": harvest,
+        "lost_rows": len(lost_ids),
+    }
+    dump = load_flight_dump(harvest["path"]) if block["harvested"] else None
+    if dump is None:
+        block["harvested"] = False
+        block["attribution"] = None
+        return block
+    inflight = (dump.get("extra") or {}).get("inflight") or {}
+    where: dict[str, dict] = {}
+    disp = inflight.get("dispatching")
+    if disp:
+        for req in disp.get("requests") or []:
+            rid = req.get("request_id")
+            if rid:
+                where[rid] = {
+                    "where": "dispatching",
+                    "batch_seq": disp.get("batch_seq"),
+                    "bucket": disp.get("bucket"),
+                }
+    for req in inflight.get("queued") or []:
+        rid = req.get("request_id")
+        if rid:
+            where.setdefault(rid, {"where": "queued", "batch_seq": None})
+    for entry in dump.get("entries") or []:
+        rid = entry.get("request_id")
+        if rid:
+            where.setdefault(
+                rid,
+                {
+                    "where": "completed",
+                    "batch_seq": entry.get("batch_seq"),
+                },
+            )
+    attribution = {rid: where.get(rid) for rid in lost_ids}
+    untracked = sorted(r for r, w in attribution.items() if w is None)
+    by_where: dict[str, int] = {}
+    for w in attribution.values():
+        if w is not None:
+            by_where[w["where"]] = by_where.get(w["where"], 0) + 1
+    block["attribution"] = {
+        "by_request": attribution,
+        "by_where": by_where,
+        "attributed": len(lost_ids) - len(untracked),
+        "untracked": untracked,
+        "dispatching_batch_seq": disp.get("batch_seq") if disp else None,
+    }
+    return block
+
+
 def fleet_sweep(
     config_path: str,
     make_body: Callable[[int], bytes],
@@ -233,6 +296,11 @@ def fleet_sweep(
     # the measured knee would then reflect the budget, not the fleet
     router_kw = dict(router_kw or {})
     router_kw.setdefault("retry_budget", max(int(c) for c in counts) - 1)
+    # fleet-level incident detector: the chaos kill opens a replica_dead
+    # incident here with the harvested flight dump frozen as evidence,
+    # and the router surfaces the same detector on fleet /healthz
+    incidents = router_kw.get("incidents") or IncidentDetector(clock=clock)
+    router_kw.setdefault("incidents", incidents)
     router = Router(manager, **router_kw)
     level_kw = dict(
         timeout_s=timeout_s,
@@ -319,9 +387,18 @@ def fleet_sweep(
                 kill_report.update(manager.kill(victim.replica_id))
 
             counters_before = router.counters_snapshot()
+
+            def chaos_body(i: int) -> bytes:
+                # deterministic request ids: the flight dump harvested
+                # from the victim names these same ids, so every lost row
+                # joins to the dump entry (batch / queue slot) it died in
+                doc = json.loads(make_body(i))
+                doc["request_id"] = f"chaos-{i:04d}"
+                return json.dumps(doc).encode()
+
             chaos_level = run_fleet_level(
                 router,
-                make_body,
+                chaos_body,
                 chaos_rate,
                 n_requests * 2,
                 seed=seed + 101,
@@ -333,6 +410,7 @@ def fleet_sweep(
             victim_id = kill_report.get("replica_id")
             requests = chaos_level.pop("requests")
             lost_dead = lost_unaccounted = 0
+            lost_ids: list[str] = []
             for r in requests:
                 if r["status"] in (200, 429, 504):
                     continue
@@ -341,14 +419,35 @@ def fleet_sweep(
                     r.get("served_by") == victim_id
                 ):
                     lost_dead += 1
+                    lost_ids.append(f"chaos-{r['i']:04d}")
                 else:
                     lost_unaccounted += 1
+            flight_block = _attribute_losses(
+                kill_report.get("flight"), lost_ids
+            )
             failovers = {
                 k: counters_after.get(k, 0) - counters_before.get(k, 0)
                 for k in counters_after
                 if k.startswith(("failover_", "retries", "shed_"))
                 and counters_after.get(k, 0) != counters_before.get(k, 0)
             }
+            # the induced kill is an incident on the record: evidence
+            # (kill report incl. flight-dump summary, per-batch loss
+            # attribution, the failover story) frozen at open time
+            incidents.open(
+                "replica_dead",
+                f"replica {victim_id} SIGKILLed mid-level with "
+                f"{kill_report.get('in_flight_at_kill')} in flight",
+                severity="critical",
+                evidence={
+                    "kill": kill_report,
+                    "flight": flight_block,
+                    "lost_dead_replica": lost_dead,
+                    "lost_unaccounted": lost_unaccounted,
+                    "router_failover_delta": failovers,
+                },
+                dedupe_key=f"replica_dead:{victim_id}",
+            )
             # recovery: the survivor re-runs the per-replica ladder; its
             # knee must come back to the (N-1)=1-replica level
             manager.poll()
@@ -377,6 +476,7 @@ def fleet_sweep(
                     "rejected_backpressure": chaos_level["rejected"],
                     "retried": chaos_level["retried"],
                     "router_failover_delta": failovers,
+                    "flight": flight_block,
                 },
                 "recovery": {
                     "levels": recovery_levels,
@@ -389,6 +489,13 @@ def fleet_sweep(
                     ),
                 },
             }
+            # the frozen evidence outlives the resolve — the record keeps
+            # the full incident; resolving marks the fleet healthy again
+            incidents.resolve(
+                f"replica_dead:{victim_id}",
+                "survivor recovery ladder complete (recovery_ratio="
+                f"{chaos_block['recovery']['recovery_ratio']})",
+            )
     finally:
         final_view = manager.fleet_view()
         manager.close()
@@ -417,6 +524,7 @@ def fleet_sweep(
         "telemetry": telemetry_block(
             ledger_since=ledger_mark,
             gaps_since=gaps_mark,
+            incidents=incidents_block(incidents),
         ),
     }
     return validate_record(record, "fleet")

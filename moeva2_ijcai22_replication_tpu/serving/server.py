@@ -42,6 +42,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from ..observability.fleetrace import TRACE_HEADER, parse_trace_context
 from ..observability.prom import prometheus_text
 from .batcher import BatchExecutionError, DeadlineExceeded, QueueFull, RequestTooLarge
 from .service import AttackRequest, AttackService, InvalidRequest
@@ -158,10 +159,31 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
             return
         body = self.rfile.read(length)
         parts = urlsplit(self.path)
+        service = self.server.service
+        if parts.path == "/debug/flight":
+            # black-box dump on demand: the fleet manager calls this just
+            # before SIGKILL and harvests the returned path, so the chaos
+            # accounting can attribute lost rows to the exact batch
+            try:
+                payload = json.loads(body) if body else {}
+            except json.JSONDecodeError:
+                payload = {}
+            reason = str(
+                (payload or {}).get("reason")
+                or parse_qs(parts.query).get("reason", ["manual"])[0]
+            )
+            try:
+                self._send(200, service.flight_dump(reason))
+            except Exception as e:  # noqa: BLE001 — a dump failure must
+                self._send(500, {"error": f"flight dump failed: {e!r}"})
+            return  # not take the handler thread down
         if parts.path != "/attack":
             self._send(404, {"error": f"no route {self.path}"})
             return
-        service = self.server.service
+        # distributed trace context (X-Moeva2-Trace): the fleet router's
+        # trace id + attempt span + hop count; malformed/absent -> None
+        # and the request traces standalone exactly as before
+        trace_ctx = parse_trace_context(self.headers.get(TRACE_HEADER))
         try:
             payload = json.loads(body)
             req = AttackRequest(
@@ -191,10 +213,16 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
             ).name
         stream_mode = parse_qs(parts.query).get("stream", [""])[0]
         if stream_mode:
-            self._attack_streaming(service, req, stream_mode, qos_hdrs)
+            self._attack_streaming(
+                service, req, stream_mode, qos_hdrs, trace_ctx
+            )
             return
         try:
-            resp = service.attack(req, timeout=self.server.request_timeout_s)
+            resp = service.attack(
+                req,
+                timeout=self.server.request_timeout_s,
+                trace_context=trace_ctx,
+            )
         except InvalidRequest as e:
             self._send(400, {"error": str(e)}, headers=qos_hdrs)
         except RequestTooLarge as e:
@@ -229,15 +257,19 @@ class AttackHTTPHandler(BaseHTTPRequestHandler):
                 headers=qos_hdrs,
             )
 
-    def _attack_streaming(self, service, req, mode: str, qos_hdrs: dict):
+    def _attack_streaming(
+        self, service, req, mode: str, qos_hdrs: dict, trace_ctx=None
+    ):
         """``stream=poll`` -> 202 + request id (read via GET
         ``/attack/<id>?cursor=N``); anything else (``stream=1``) -> chunked
         JSON-lines: partial records as rows park, then the final
         ``{"done": true}`` record. Submission errors map exactly like the
         blocking route; errors AFTER the 200 header is on the wire ride the
-        final record instead (chunked transfer can't change the status)."""
+        final record instead (chunked transfer can't change the status).
+        Partial chunks never carry trace data — the request trace rides
+        only the final record's meta."""
         try:
-            stream, fut = service.submit_stream(req)
+            stream, fut = service.submit_stream(req, trace_context=trace_ctx)
         except InvalidRequest as e:
             self._send(400, {"error": str(e)}, headers=qos_hdrs)
             return

@@ -29,6 +29,7 @@ they got.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -43,6 +44,8 @@ from ..attacks.sharding import describe_mesh
 from ..experiments import common
 from ..observability import (
     CapacityModel,
+    FlightRecorder,
+    IncidentDetector,
     SloTracker,
     Trace,
     TraceRecorder,
@@ -54,6 +57,7 @@ from ..observability import (
     get_coldstart,
     get_gap_tracker,
     get_ledger,
+    incidents_block,
     maybe_span,
     mesh_snapshot,
     sample_from_per_state,
@@ -222,6 +226,10 @@ class AttackService:
         start: bool = True,
         replica_id: str | None = None,
         qos=None,
+        flight_ring: int = 64,
+        incident_detection: bool = True,
+        flight_dir: str = "out",
+        incident_tick_s: float = 2.0,
     ):
         self.domains = dict(domains)
         self.seed = int(seed)
@@ -296,6 +304,21 @@ class AttackService:
             retry_after_fn=self.capacity.retry_after_s,
             qos=qos,
         )
+        # black-box flight recorder (observability.flightrec): a bounded
+        # ring of completed request journeys fed from the done-callback —
+        # host-side dict appends only, so flight_ring on/off shares every
+        # compile and dispatch bit-identically. 0 disables the ring.
+        self.flight = FlightRecorder(capacity=flight_ring)
+        self.flight_dir = flight_dir
+        # incident detector (observability.incidents): predicate passes
+        # over the SLO/capacity snapshots the service already assembles,
+        # rate-limited to one pass per ``incident_tick_s`` on the
+        # done-callback path — pure host-side comparisons
+        self.incidents = IncidentDetector(
+            enabled=incident_detection, clock=self.clock
+        )
+        self.incident_tick_s = float(incident_tick_s)
+        self._incident_next_t = self.clock() + self.incident_tick_s
         self._resolved: dict[tuple, _Resolved] = {}
         #: boot-time warmup report (None until :meth:`prewarm` ran)
         self._prewarm_report: dict | None = None
@@ -740,6 +763,77 @@ class AttackService:
             qos_classes=ctx.get("batch_classes"),
         )
 
+    # -- incidents & flight recorder ----------------------------------------
+    def _incident_evidence(self) -> dict:
+        """The correlated evidence an incident freezes at open time: top
+        gap stages, recent recompile causes, the shed matrix, queue depth,
+        and the tail of the flight ring (the offending request journeys).
+        All snapshots the service already assembles — pure host reads."""
+        return {
+            "replica_id": self.replica_id,
+            "top_gap_stages": get_gap_tracker().gaps_block().get(
+                "top_gap_stages"
+            ),
+            "recompile_causes": get_ledger().recompile_causes[
+                -self.RECOMPILE_CAUSES_SHOWN :
+            ],
+            "shed": self.slo.shed_block(),
+            "queue_depth_rows": self.batcher.queue_depth_rows(),
+            "flight_tail": self.flight.entries()[-8:],
+        }
+
+    def _incident_tick(self) -> None:
+        """Rate-limited predicate pass on the done-callback path: at most
+        one evaluation per ``incident_tick_s`` of the injectable clock."""
+        if not self.incidents.enabled:
+            return
+        now = self.clock()
+        with self._lock:
+            if now < self._incident_next_t:
+                return
+            self._incident_next_t = now + self.incident_tick_s
+        self.incidents.tick(
+            slo=self.slo.snapshot(),
+            capacity=self.capacity.snapshot(),
+            evidence_fn=self._incident_evidence,
+        )
+
+    def flight_dump(
+        self,
+        reason: str,
+        out_dir: str | None = None,
+        extra: dict | None = None,
+    ) -> dict:
+        """Serialize the black box atomically to
+        ``<flight_dir>/flight_<replica>_<reason>.json``: the completed-
+        request ring plus what was IN FLIGHT (the batcher's queued and
+        dispatching view) and the ledger/capacity/gap/shed/incident
+        snapshots at dump time. The fleet manager harvests this over
+        ``POST /debug/flight`` just before SIGKILL; ``tools/serve.py``
+        dumps on SIGTERM — either way a chaos ``lost_dead_replica`` row
+        becomes attributable to the exact batch it died in."""
+        label = self.replica_id or "service"
+        safe = "".join(
+            c if c.isalnum() or c in "-_" else "-" for c in str(reason)
+        )
+        path = os.path.join(
+            out_dir or self.flight_dir, f"flight_{label}_{safe}.json"
+        )
+        extra_block = {
+            "inflight": self.batcher.inflight_view(),
+            "ledger": get_ledger().summary(),
+            "capacity": self.capacity.snapshot(),
+            "gaps": get_gap_tracker().gaps_block(),
+            "shed": self.slo.shed_block(),
+            "incidents": incidents_block(self.incidents),
+        }
+        if extra:
+            extra_block.update(extra)
+        return self.flight.dump(
+            path, reason=str(reason), replica_id=self.replica_id,
+            extra=extra_block,
+        )
+
     def _validate(self, req: AttackRequest, res: _Resolved) -> np.ndarray:
         x = np.asarray(req.x, dtype=np.float64)
         if x.ndim != 2 or x.shape[0] < 1:
@@ -754,7 +848,12 @@ class AttackService:
         return x
 
     # -- request path --------------------------------------------------------
-    def submit(self, req: AttackRequest, on_partial: Callable | None = None):
+    def submit(
+        self,
+        req: AttackRequest,
+        on_partial: Callable | None = None,
+        trace_context: dict | None = None,
+    ):
         """Validate + enqueue; returns a Future of ``(x_adv, meta)``.
 
         Raises :class:`InvalidRequest` / :class:`~.batcher.QueueFull` /
@@ -763,6 +862,12 @@ class AttackService:
         ``on_partial`` (streaming) receives ``(local_rows, x_rows, gen)``
         as this request's solved rows surface mid-dispatch — wired by
         :meth:`submit_stream`, which owns the stream bookkeeping.
+        ``trace_context`` (a parsed ``X-Moeva2-Trace`` header —
+        ``observability.fleetrace.parse_trace_context``) makes this
+        request's trace a CONTINUATION of the router's: the trace id is
+        adopted verbatim and the replica's root spans parent under the
+        router's attempt span, so a merged fleet document shows one
+        composed tree per request.
         """
         rid = req.request_id or uuid.uuid4().hex[:12]
         # class resolution is a dict lookup — do it before validate so
@@ -777,15 +882,30 @@ class AttackService:
         # replica-labelled trace ids: a fleet's merged trace streams stay
         # attributable per process
         tid = f"{self.replica_id}:req-{rid}" if self.replica_id else f"req-{rid}"
+        root_parent = None
+        if trace_context:
+            # distributed propagation: the router already minted the trace
+            # id — adopt it verbatim (the merged fleet doc gets ONE process
+            # row per request id) and hang this replica's root spans under
+            # the router's attempt span id
+            tid = trace_context.get("trace_id") or tid
+            root_parent = trace_context.get("parent_span")
         trace = (
             Trace(
                 self.recorder,
                 trace_id=tid,
                 name=f"{req.attack}/{req.domain}",
+                root_parent=root_parent,
             )
             if self.recorder.spans_enabled
             else None
         )
+        if trace is not None and trace_context:
+            trace.event(
+                "trace_adopted",
+                hop=int(trace_context.get("hop") or 0),
+                replica=self.replica_id,
+            )
         # self.clock, not time.perf_counter: every stage feeding one
         # histogram family must share the injectable clock domain, or a
         # fake-clock test (the batcher's start=False pattern) can steer
@@ -862,6 +982,23 @@ class AttackService:
             ok = f.exception() is None
             self.metrics.observe("latency_s", latency)
             self.metrics.count("completed" if ok else "failed")
+            if self.flight.enabled:
+                # flight-recorder entry: the journey summary the black box
+                # keeps (host-side dicts — never touches device work)
+                entry = {
+                    "request_id": rid,
+                    "trace_id": tid,
+                    "domain": req.domain,
+                    "attack": req.attack,
+                    "rows": int(x.shape[0]),
+                    "status": "ok" if ok else type(f.exception()).__name__,
+                    "latency_s": round(latency, 6),
+                }
+                if ok:
+                    m = f.result()[1]
+                    entry["batch_seq"] = m.get("batch_seq")
+                    entry["bucket_size"] = m.get("bucket_size")
+                self.flight.note(entry)
             if trace is not None:
                 # end-to-end marker in the event stream (the span tree in
                 # the response meta was already assembled at dispatch time)
@@ -880,21 +1017,31 @@ class AttackService:
                     status="ok" if ok else type(f.exception()).__name__,
                     latency_s=round(latency, 6),
                 )
+            self._incident_tick()
 
         fut.add_done_callback(_done)
+        # the streaming path needs the request trace AFTER completion (to
+        # stamp the time_to_first_solved event and re-render the tree onto
+        # the FINAL chunk only); partial chunks stay trace-free
+        fut.request_trace = trace
         return fut
 
     def attack(
-        self, req: AttackRequest, timeout: float | None = None
+        self,
+        req: AttackRequest,
+        timeout: float | None = None,
+        trace_context: dict | None = None,
     ) -> AttackResponse:
         """Blocking request path: submit, wait, unwrap."""
-        fut = self.submit(req)
+        fut = self.submit(req, trace_context=trace_context)
         x_adv, meta = fut.result(timeout=timeout)
         return AttackResponse(
             request_id=meta["request_id"], x_adv=x_adv, meta=meta
         )
 
-    def submit_stream(self, req: AttackRequest):
+    def submit_stream(
+        self, req: AttackRequest, trace_context: dict | None = None
+    ):
         """Streaming request path: returns ``(ResultStream, Future)``.
 
         The stream surfaces this request's solved rows as the MoEvA
@@ -917,7 +1064,9 @@ class AttackService:
         self.streams.add(stream)
         t_submit = self.clock()
         try:
-            fut = self.submit(req, on_partial=stream.put)
+            fut = self.submit(
+                req, on_partial=stream.put, trace_context=trace_context
+            )
         except BaseException as exc:
             stream.fail(exc)
             raise
@@ -936,6 +1085,18 @@ class AttackService:
                 meta["time_to_first_solved_s"] = round(ttfs, 6)
                 self.metrics.observe("time_to_first_solved_s", ttfs)
             self.metrics.observe("time_to_complete_s", ttc)
+            tr = getattr(f, "request_trace", None)
+            if tr is not None and tr.enabled:
+                # the streaming headline joins the trace as an event, and
+                # the tree is re-rendered so it rides the FINAL chunk's
+                # meta — partial chunks never carry trace data
+                if stream.t_first_solved is not None:
+                    tr.event(
+                        "time_to_first_solved",
+                        seconds=round(ttfs, 6),
+                        rows_streamed=stream.rows_streamed,
+                    )
+                meta["trace"] = tr.tree()
             stream.finish(x_adv, meta)
 
         fut.add_done_callback(_finish)
@@ -1042,6 +1203,11 @@ class AttackService:
         return {
             "ok": True,
             "uptime_s": round(time.time() - self._t0, 3),
+            # wall-clock at response assembly: the router's clock-offset
+            # handshake (fleetrace.clock_offset) reads this against its
+            # own send/receive instants at /healthz poll time, so merged
+            # fleet traces align per-replica tracks without NTP trust
+            "now_wall": round(time.time(), 6),
             # fleet label (None outside a fleet): the ReplicaManager keys
             # its fleet view by this, and refuses a replica whose id moved
             "replica_id": self.replica_id,
@@ -1097,6 +1263,13 @@ class AttackService:
             # QoS layer state (None when no policy is wired): the class
             # taxonomy, per-class admission buckets, live stream count
             "qos": self.qos_snapshot(),
+            # incident attribution: open/total incident counts and the
+            # bounded history with frozen evidence — "p99 regressed"
+            # becomes "p99 regressed because bucket-1024 recompiled"
+            "incidents": incidents_block(self.incidents),
+            # black-box state: ring occupancy + dump count (the dumps
+            # themselves land in flight_dir, harvested by the fleet)
+            "flight": self.flight.snapshot(),
             "caches": {
                 "engine": dict(
                     common.ENGINES.stats(),
@@ -1179,6 +1352,10 @@ class AttackService:
         snap["coldstart"] = get_coldstart().cold_block()
         if self.qos is not None:
             snap["qos"] = self.qos_snapshot()
+        # incident + flight-recorder state: JSON here, incidents_open /
+        # incidents_total{kind} / flight_ring_entries gauges under prom
+        snap["incidents"] = incidents_block(self.incidents)
+        snap["flight"] = self.flight.snapshot()
         return snap
 
     def close(self):

@@ -28,6 +28,7 @@ from typing import Callable, Sequence
 from ..observability import (
     detect_knee,
     get_ledger,
+    incidents_block,
     quality_block,
     slo_block,
     telemetry_block,
@@ -302,6 +303,11 @@ def offered_load_sweep(
                     knee=knee,
                     capacity=service.capacity.snapshot(),
                 ),
+                # incident attribution: whatever the service's detector
+                # opened during the sweep (slo_breach/shed_spike/...),
+                # evidence frozen at open time — required on serving
+                # records by validate_record
+                incidents=incidents_block(service.incidents),
             ),
         },
         "serving",

@@ -17,7 +17,15 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 import pytest
 
+from moeva2_ijcai22_replication_tpu.observability import TraceRecorder
 from moeva2_ijcai22_replication_tpu.observability.capacity import CapacityModel
+from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+    TRACE_HEADER,
+    parse_trace_context,
+)
+from moeva2_ijcai22_replication_tpu.observability.flightrec import (
+    load_flight_dump,
+)
 from moeva2_ijcai22_replication_tpu.observability.slo import (
     SloTracker,
     merge_histogram_snapshots,
@@ -97,14 +105,19 @@ class ScriptedHTTP:
 
 
 class ScriptedPost:
-    """url -> (status, headers, body) | Exception | callable, per /attack."""
+    """url -> (status, headers, body) | Exception | callable, per /attack.
+
+    Captures the per-attempt request ``headers`` the router stamps (every
+    forward now carries ``X-Moeva2-Trace``) next to each call's url."""
 
     def __init__(self, responses):
         self.responses = dict(responses)
         self.calls = []
+        self.headers = []
 
-    def __call__(self, url, body, timeout_s=None):
+    def __call__(self, url, body, timeout_s=None, headers=None):
         self.calls.append(url)
+        self.headers.append(dict(headers or {}))
         resp = self.responses[url]
         if callable(resp) and not isinstance(resp, Exception):
             resp = resp()
@@ -258,6 +271,35 @@ class TestReplicaLifecycle:
         assert view["by_state"] == {"dead": 1}
         assert view["routable"] == 0
 
+    def test_poll_failure_records_last_poll_error(self):
+        walls = FakeClock(1000.0)
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        mgr.wall = walls
+        http.set("mem://r01/healthz", ConnectionError("wedged"))
+        h = mgr.get("r01")
+        mgr.poll()
+        # the LAST failure (text + wall timestamp) survives next to the
+        # count — the first question in any incident
+        assert h.poll_errors == 1
+        assert "wedged" in h.last_poll_error["error"]
+        assert h.last_poll_error["t_wall"] == 1000.0
+        assert h.view()["last_poll_error"] == h.last_poll_error
+
+    def test_poll_measures_clock_offset_from_now_wall(self):
+        h_resp = health("r01")
+        # replica's own wall clock rides every healthz; against the
+        # manager's send/recv bracket [100.0, 100.2] the NTP midpoint
+        # rule gives offset = 123.45 - 100.1
+        h_resp["now_wall"] = 123.45
+        mgr, http, fc = make_fleet({"r01": h_resp})
+        wall_times = [100.0, 100.2]
+        mgr.wall = lambda: wall_times.pop(0)
+        mgr.poll()
+        h = mgr.get("r01")
+        assert h.clock_offset_s == pytest.approx(23.35)
+        assert h.clock_rtt_s == pytest.approx(0.2)
+        assert h.view()["clock_offset_s"] == h.clock_offset_s
+
     def test_fleet_view_aggregates_capacity_and_build(self):
         mgr, http, fc = make_fleet(
             {
@@ -334,10 +376,35 @@ class TestDrainAndKill:
             "replica_id": "r01",
             "in_flight_at_kill": 3,
             "pid": 777,
+            # the default http_post cannot reach a mem:// replica — the
+            # harvest is best-effort and the report says it got nothing
+            "flight": None,
         }
         assert h.state == "dead" and h.proc.killed
         with pytest.raises(ValueError, match="state dead"):
             mgr.drain("r01")
+
+    def test_kill_harvests_flight_dump_before_sigkill(self):
+        mgr, http, fc = make_fleet({"r01": health("r01")})
+        h = mgr.get("r01")
+        h.proc = FakeProc()
+        posts = []
+        harvest = {"path": "/tmp/flight_r01.json", "reason": "x", "entries": 5}
+
+        def http_post(url, payload, timeout_s=None):
+            posts.append((url, payload, h.proc.killed))
+            return dict(harvest)
+
+        mgr.http_post = http_post
+        report = mgr.kill("r01")
+        # the black box was pulled over POST /debug/flight BEFORE the
+        # SIGKILL landed (SIGKILL leaves the replica no moment to dump)
+        assert posts == [
+            ("mem://r01/debug/flight", {"reason": "chaos_kill_r01"}, False)
+        ]
+        assert report["flight"] == harvest
+        assert h.flight_dump == harvest
+        assert h.state == "dead" and h.proc.killed
 
 
 # ---------------------------------------------------------------------------
@@ -485,6 +552,166 @@ class TestRouterFailover:
         assert headers["X-Fleet-Attempts"] == "1"
         assert len(post.calls) == 1
         assert router.counters_snapshot()["retries"] == 0
+
+
+def meta_post(rid):
+    """A replica-shaped 200: a dict body with a ``meta`` dict — the only
+    shape the router's route-meta injection rewrites."""
+    return (
+        200,
+        {"X-Replica-Id": rid},
+        json.dumps({"x_adv": [], "meta": {"replica_id": rid}}).encode(),
+    )
+
+
+class TestRouterTracePropagation:
+    def two_replica_router(self, r01_resp, r02_resp, **kw):
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=1.0),
+                "r02": health("r02", qps=10.0, age=1.0),
+            }
+        )
+        post = ScriptedPost(
+            {"mem://r01/attack": r01_resp, "mem://r02/attack": r02_resp}
+        )
+        return Router(mgr, http_post=post, clock=fc, **kw), mgr, post
+
+    def test_every_forward_carries_trace_context(self):
+        # even with NO span recorder the router propagates identity + hop
+        # count (parent 0 = none); a body without a meta dict passes
+        # through the route-meta injection untouched
+        router, mgr, post = self.two_replica_router(
+            ok_post("r01"), ok_post("r02")
+        )
+        status, _, body = router.route(b"{}")
+        assert status == 200
+        ctx = parse_trace_context(post.headers[0][TRACE_HEADER])
+        assert ctx["trace_id"].startswith("fleet-")
+        assert ctx["parent_span"] is None
+        assert ctx["hop"] == 1
+        assert json.loads(body) == {"rid": "r01"}
+
+    def test_failover_attempts_share_trace_distinct_parent_spans(self):
+        rec = TraceRecorder(spans_enabled=True)
+        router, mgr, post = self.two_replica_router(
+            ConnectionRefusedError("dead"),
+            ok_post("r02"),
+            retry_budget=2,
+            recorder=rec,
+        )
+        status, headers, _ = router.route(b"{}")
+        assert status == 200 and headers["X-Served-By"] == "r02"
+        ctxs = [parse_trace_context(h[TRACE_HEADER]) for h in post.headers]
+        assert len(ctxs) == 2
+        # ONE trace id across the whole failover chain...
+        assert ctxs[0]["trace_id"] == ctxs[1]["trace_id"]
+        assert [c["hop"] for c in ctxs] == [1, 1]
+        # ...with each attempt's own span as the remote parent, so the
+        # replica trees compose under the right attempt in a merged doc
+        assert ctxs[0]["parent_span"] != ctxs[1]["parent_span"]
+        assert all(c["parent_span"] for c in ctxs)
+        events = rec.events()
+        attempts = [
+            e
+            for e in events
+            if e.get("kind") == "span" and e.get("name") == "attempt"
+        ]
+        assert [a["attrs"]["replica"] for a in attempts] == ["r01", "r02"]
+        assert {a["span"] for a in attempts} == {
+            c["parent_span"] for c in ctxs
+        }
+        assert any(
+            e.get("name") == "failover"
+            and e["attrs"]["cause"] == "connection"
+            for e in events
+        )
+
+    def test_upstream_context_adopted_and_hop_incremented(self):
+        router, mgr, post = self.two_replica_router(
+            ok_post("r01"), ok_post("r02")
+        )
+        router.route(
+            b"{}",
+            trace_context={"trace_id": "up-abc", "parent_span": 7, "hop": 2},
+        )
+        ctx = parse_trace_context(post.headers[0][TRACE_HEADER])
+        assert ctx["trace_id"] == "up-abc"  # adopted, not re-minted
+        assert ctx["hop"] == 3
+
+    def test_served_meta_carries_per_attempt_route_detail(self):
+        router, mgr, post = self.two_replica_router(
+            ConnectionRefusedError("dead"), meta_post("r02"), retry_budget=2
+        )
+        status, headers, body = router.route(b"{}")
+        assert status == 200
+        route = json.loads(body)["meta"]["route"]
+        ctx = parse_trace_context(post.headers[0][TRACE_HEADER])
+        assert route["trace_id"] == ctx["trace_id"]
+        assert route["hops"] == 1
+        att = route["attempts"]
+        assert [(a["replica"], a["status"], a["cause"]) for a in att] == [
+            ("r01", None, "connection"),
+            ("r02", 200, "served"),
+        ]
+        assert all(a["elapsed_s"] >= 0 for a in att)
+
+    def test_exhausted_budget_response_keeps_upstream_body(self):
+        reject = (429, {}, json.dumps({"error": "queue full"}).encode())
+        router, mgr, post = self.two_replica_router(
+            reject, reject, retry_budget=1
+        )
+        status, _, body = router.route(b"{}")
+        assert status == 429
+        # error bodies are never rewritten with route meta
+        assert json.loads(body) == {"error": "queue full"}
+
+
+class TestRouterServedBalance:
+    def starved_router(self, **kw):
+        """Two routable replicas, every request served by r01 (its
+        capacity headroom always ranks first; r02 starves)."""
+        mgr, http, fc = make_fleet(
+            {
+                "r01": health("r01", qps=100.0, age=1.0),
+                "r02": health("r02", qps=10.0, age=1.0),
+            }
+        )
+        post = ScriptedPost(
+            {"mem://r01/attack": ok_post("r01"), "mem://r02/attack": ok_post("r02")}
+        )
+        return Router(mgr, http_post=post, clock=fc, **kw), mgr, post
+
+    def test_unprimed_then_measured_ratio(self):
+        router, mgr, post = self.starved_router()
+        # no served traffic yet: unprimed, not "perfectly imbalanced"
+        assert router.served_balance() is None
+        for _ in range(4):
+            assert router.route(b"{}")[0] == 200
+        bal = router.served_balance()
+        # all 4 on r01, r02 at 0: mean/max = (4/2)/4 = 0.5 exactly —
+        # with 2 replicas total starvation floors at 0.5, which is why
+        # the default floor is 0.5 (< 0.5 needs 3+ replicas skewed)
+        assert bal == {"ratio": 0.5, "served": {"r01": 4, "r02": 0}}
+        assert router.healthz()["router"]["served_balance"] == bal
+
+    def test_balance_drop_opens_incident_on_healthz_tick(self):
+        from moeva2_ijcai22_replication_tpu.observability import (
+            IncidentDetector,
+        )
+
+        det = IncidentDetector(clock=FakeClock(), balance_drop_floor=0.6)
+        router, mgr, post = self.starved_router(incidents=det)
+        for _ in range(4):
+            router.route(b"{}")
+        hz = router.healthz()  # /healthz is the balance tick point
+        inc = hz["incidents"]
+        assert inc["open"] == 1 and inc["by_kind"] == {"balance_drop": 1}
+        rec = inc["incidents"][-1]
+        assert rec["kind"] == "balance_drop" and rec["state"] == "open"
+        assert rec["frozen"] is True
+        assert rec["evidence"]["served"] == {"r01": 4, "r02": 0}
+        assert rec["evidence"]["trigger"]["ratio"] == 0.5
 
 
 class TestRouterAggregation:
@@ -852,6 +1079,10 @@ class TestFleetSubprocess:
                 "max_queue_rows": 256,
                 "request_timeout_s": 120.0,
                 "capacity_window": 64,
+                # fleet tracing: one shared config, per-replica sink paths
+                # templated by serve.py (trace_r01.jsonl, trace_r02.jsonl)
+                "trace_log": str(tmp_path / "trace.jsonl"),
+                "flight_dir": str(tmp_path / "flight"),
             },
             "system": {"jax_cache_dir": str(tmp_path / "jax_cache")},
         }
@@ -874,7 +1105,13 @@ class TestFleetSubprocess:
                 manager.expected_build
             )
 
-            router = Router(manager, retry_budget=2, request_timeout_s=180.0)
+            router_sink = str(tmp_path / "trace_router.jsonl")
+            router = Router(
+                manager,
+                retry_budget=2,
+                request_timeout_s=180.0,
+                recorder=TraceRecorder(sink_path=router_sink),
+            )
             body = json.dumps(
                 {
                     "domain": "lcld",
@@ -891,22 +1128,63 @@ class TestFleetSubprocess:
             victim_id = headers["X-Served-By"]
             # the replica stamps its own identity end-to-end
             assert headers.get("X-Replica-Id") == victim_id
+            # the routed response's meta carries the routing story AND the
+            # replica's own span tree under the router-minted trace id
+            meta = json.loads(resp)["meta"]
+            assert meta["route"]["hops"] == 1
+            assert meta["route"]["attempts"][-1]["cause"] == "served"
+            assert "trace" in meta
             victim = manager.get(victim_id)
             survivor = h2 if victim is h1 else h1
             manager.poll()
+            # the healthz handshake measured each replica's clock offset
+            # (same host: sub-second) — what the fleet merge aligns with
+            assert victim.clock_offset_s is not None
+            assert abs(victim.clock_offset_s) < 5.0
 
             # chaos: SIGKILL behind the manager's back — the router still
             # believes the victim is admitted, so a forward can hit the
             # dead socket and must fail over within the retry budget
             victim.proc.kill()
             victim.proc.wait(timeout=15)
+            failover_routes = []
             for _ in range(2):  # round-robin puts the corpse first once
                 status, headers, resp = router.route(body)
                 assert status == 200, resp[:300]
                 assert headers["X-Served-By"] == survivor.replica_id
+                failover_routes.append(json.loads(resp)["meta"]["route"])
             counters = router.counters_snapshot()
             assert counters["failover_connection_total"] >= 1
             assert counters.get(f"failover_connection:{victim_id}", 0) >= 1
+            # at least one forward hit the corpse first: its response meta
+            # names the dead replica's connection failure, then the
+            # survivor — the per-attempt routing story, client-visible
+            chains = [
+                [(a["replica"], a["cause"]) for a in r["attempts"]]
+                for r in failover_routes
+            ]
+            assert [
+                (victim_id, "connection"),
+                (survivor.replica_id, "served"),
+            ] in chains
+            survived_trace = failover_routes[-1]["trace_id"]
+
+            # black box: the survivor's flight ring holds the journeys it
+            # completed; POST /debug/flight dumps them atomically to disk
+            from moeva2_ijcai22_replication_tpu.serving.fleet.replica import (
+                default_http_post_json,
+            )
+
+            harvest = default_http_post_json(
+                survivor.url + "/debug/flight", {"reason": "test_harvest"}
+            )
+            dump = load_flight_dump(harvest["path"])
+            assert dump["kind"] == "flight_dump"
+            assert dump["replica_id"] == survivor.replica_id
+            assert len(dump["entries"]) >= 1
+            assert {"inflight", "incidents", "capacity"} <= set(
+                dump["extra"]
+            )
 
             # the next poll round notices the corpse; routing excludes it
             manager.poll()
@@ -925,5 +1203,50 @@ class TestFleetSubprocess:
             assert report["drained_clean"] is True
             assert survivor.state == "terminated"
             assert survivor.proc.poll() is not None
+
+            # graceful end leaves the black box on disk: serve.py's
+            # SIGTERM handler dumped before exiting
+            sigterm_dump = load_flight_dump(
+                str(
+                    tmp_path
+                    / "flight"
+                    / f"flight_{survivor.replica_id}_sigterm.json"
+                )
+            )
+            assert sigterm_dump is not None
+            assert sigterm_dump["reason"] == "sigterm"
+
+            # fleet trace merge: the router's sink + the survivor's
+            # per-replica sink compose into ONE document where the routed
+            # trace id appears on BOTH sides of the HTTP hop
+            from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+                merge_fleet_traces,
+            )
+
+            router.recorder.close()
+            survivor_sink = str(
+                tmp_path / f"trace_{survivor.replica_id}.jsonl"
+            )
+            doc = merge_fleet_traces(
+                {
+                    "router": router_sink,
+                    survivor.replica_id: survivor_sink,
+                },
+                offsets={
+                    survivor.replica_id: survivor.clock_offset_s or 0.0
+                },
+            )
+            merge_report = doc["otherData"]["fleet_merge"]
+            assert set(merge_report["replicas"]) == {
+                "router",
+                survivor.replica_id,
+            }
+            assert merge_report["skipped"] == {}
+            by_pid = {
+                e["args"]["name"]
+                for e in doc["traceEvents"]
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+            }
+            assert survived_trace in by_pid  # one track, two processes' spans
         finally:
             manager.close()

@@ -1,0 +1,438 @@
+"""Distributed-trace propagation, flight recorder, incident attribution.
+
+Unit tier for the three fleet-observability primitives:
+
+- ``observability.fleetrace`` — the ``X-Moeva2-Trace`` context codec, the
+  NTP-midpoint clock-offset estimate, and the N-sink merge that aligns
+  per-replica JSONL streams onto one wall-clock Perfetto timeline;
+- ``observability.flightrec`` — the bounded ring of completed request
+  journeys and its atomic crash-safe dump;
+- ``observability.incidents`` — predicate trips (slo_breach, shed_spike,
+  capacity_collapse, balance_drop) that freeze correlated evidence at
+  open time, with dedupe/cooldown and the ``telemetry.incidents`` record
+  block ``validate_record`` requires on serving/fleet records.
+
+All host-side pure-Python — no JAX, no sockets, no subprocesses.
+"""
+
+import json
+
+import pytest
+
+from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+    TRACE_HEADER,
+    clock_offset,
+    format_trace_context,
+    merge_fleet_events,
+    merge_fleet_traces,
+    parse_trace_context,
+    replica_sink_path,
+)
+from moeva2_ijcai22_replication_tpu.observability.flightrec import (
+    FlightRecorder,
+    load_flight_dump,
+)
+from moeva2_ijcai22_replication_tpu.observability.incidents import (
+    INCIDENT_KEYS,
+    IncidentDetector,
+    incidents_block,
+    validate_incidents,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# trace-context codec + clock offset
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_roundtrip(self):
+        hdr = format_trace_context("r01:req-3f2a", parent_span=42, hop=2)
+        assert parse_trace_context(hdr) == {
+            "trace_id": "r01:req-3f2a",
+            "parent_span": 42,
+            "hop": 2,
+        }
+
+    def test_no_parent_encodes_as_zero_and_parses_as_none(self):
+        # a router without a span recorder still propagates identity
+        hdr = format_trace_context("fleet-abc")
+        assert hdr == "00;fleet-abc;0;0"
+        ctx = parse_trace_context(hdr)
+        assert ctx["parent_span"] is None and ctx["hop"] == 0
+
+    def test_trace_ids_with_dashes_survive(self):
+        # our trace ids legitimately contain dashes (req-<uuid>) — the
+        # delimiter is ';', so the id field is never split
+        tid = "r02:req-ab-cd-ef"
+        assert parse_trace_context(format_trace_context(tid))["trace_id"] == tid
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "01;trace;1;1",  # foreign version
+            "00;;1;1",  # empty trace id
+            "00;t;x;1",  # non-integer parent
+            "00;t;1",  # wrong arity
+        ],
+    )
+    def test_malformed_headers_parse_to_none(self, bad):
+        # propagation is best-effort: a bad header must never fail the
+        # request it rides on
+        assert parse_trace_context(bad) is None
+
+    def test_header_name_is_stable(self):
+        # the wire contract the router stamps and replicas parse
+        assert TRACE_HEADER == "X-Moeva2-Trace"
+
+    def test_replica_sink_path_templating(self):
+        # serve.py writes these paths, the fleet merge reads them back —
+        # one function owns the templating so they can never disagree
+        assert replica_sink_path("out/trace.jsonl", "r01") == (
+            "out/trace_r01.jsonl"
+        )
+        assert replica_sink_path("out/trace", "r02") == "out/trace_r02.jsonl"
+        assert replica_sink_path("out/trace.jsonl", None) == "out/trace.jsonl"
+
+
+class TestClockOffset:
+    def test_midpoint_rule(self):
+        off = clock_offset(100.0, 100.2, 123.45)
+        assert off["offset_s"] == pytest.approx(23.35)
+        assert off["rtt_s"] == pytest.approx(0.2)
+
+    def test_synchronized_clocks_measure_zero(self):
+        off = clock_offset(10.0, 10.0, 10.0)
+        assert off == {"offset_s": 0.0, "rtt_s": 0.0}
+
+    def test_negative_rtt_clamped(self):
+        # wall clocks can step between the two reads; the rtt bound must
+        # stay non-negative instead of going nonsensical
+        assert clock_offset(10.0, 9.0, 10.0)["rtt_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fleet sink merge
+# ---------------------------------------------------------------------------
+
+
+def _write_sink(path, t0_wall, events):
+    lines = [{"kind": "meta", "t0_wall": t0_wall, "pid": 1}, *events]
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    return str(path)
+
+
+class TestMergeFleet:
+    def test_merges_onto_shared_timeline_with_offsets(self, tmp_path):
+        # router epoch at wall 1000.0; replica epoch at wall 1002.0 but
+        # its clock runs 0.5s ahead — the measured offset corrects it to
+        # an effective 1002.5, i.e. 2.5s after the router's epoch
+        router = _write_sink(
+            tmp_path / "trace_router.jsonl",
+            1000.0,
+            [{"kind": "span", "name": "attempt", "trace": "t1",
+              "span": 1, "parent": None, "ts": 0.1, "dur": 0.2}],
+        )
+        replica = _write_sink(
+            tmp_path / "trace_r01.jsonl",
+            1002.0,
+            [{"kind": "span", "name": "dispatch", "trace": "t1",
+              "span": 2, "parent": 1, "ts": 0.0, "dur": 0.1}],
+        )
+        events, report = merge_fleet_events(
+            {"router": router, "r01": replica}, offsets={"r01": 0.5}
+        )
+        assert report["skipped"] == {}
+        assert report["replicas"]["router"]["shift_s"] == 0.0
+        assert report["replicas"]["r01"]["shift_s"] == pytest.approx(2.5)
+        by_name = {e["name"]: e for e in events if e.get("kind") == "span"}
+        assert by_name["attempt"]["ts"] == pytest.approx(0.1)
+        assert by_name["dispatch"]["ts"] == pytest.approx(2.5)
+        # merged stream is time-ordered after the leading meta line
+        ts = [e["ts"] for e in events[1:]]
+        assert ts == sorted(ts)
+
+    def test_gauges_keep_per_replica_tracks(self, tmp_path):
+        sinks = {
+            rid: _write_sink(
+                tmp_path / f"trace_{rid}.jsonl",
+                1000.0,
+                [{"kind": "gauge", "name": "queue_depth_rows",
+                  "value": 3.0, "ts": 0.1}],
+            )
+            for rid in ("r01", "r02")
+        }
+        events, _ = merge_fleet_events(sinks)
+        tracks = {
+            e["trace"] for e in events if e.get("kind") == "gauge"
+        }
+        # two replicas' queue depths are NOT one counter
+        assert tracks == {"r01:gauges", "r02:gauges"}
+
+    def test_missing_and_empty_sinks_reported_not_fatal(self, tmp_path):
+        empty = tmp_path / "trace_empty.jsonl"
+        empty.write_text("")
+        ok = _write_sink(
+            tmp_path / "trace_ok.jsonl",
+            5.0,
+            [{"kind": "event", "name": "x", "trace": "t", "ts": 0.0}],
+        )
+        events, report = merge_fleet_events(
+            {"gone": str(tmp_path / "nope.jsonl"), "empty": str(empty),
+             "ok": ok}
+        )
+        assert report["skipped"] == {
+            "gone": "missing sink",
+            "empty": "no meta line (empty sink?)",
+        }
+        assert list(report["replicas"]) == ["ok"]
+        assert len(events) == 2  # meta + the one event
+
+    def test_merge_fleet_traces_writes_doc_with_report(self, tmp_path):
+        sink = _write_sink(
+            tmp_path / "trace_r01.jsonl",
+            7.0,
+            [{"kind": "span", "name": "s", "trace": "t1", "span": 1,
+              "parent": None, "ts": 0.0, "dur": 0.1}],
+        )
+        out = tmp_path / "fleet.perfetto.json"
+        doc = merge_fleet_traces({"r01": sink}, out_path=str(out))
+        assert doc["otherData"]["fleet_merge"]["replicas"]["r01"]["events"] == 1
+        on_disk = json.loads(out.read_text())
+        assert on_disk["traceEvents"] == doc["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_last_n(self):
+        fr = FlightRecorder(capacity=3, clock=FakeClock(5.0))
+        for i in range(5):
+            fr.note({"request_id": f"req-{i}"})
+        entries = fr.entries()
+        assert [e["request_id"] for e in entries] == [
+            "req-2", "req-3", "req-4",
+        ]
+        assert all(e["t_wall"] == 5.0 for e in entries)
+        snap = fr.snapshot()
+        assert snap["recorded"] == 5 and snap["ring_size"] == 3
+
+    def test_capacity_zero_disables_capture(self):
+        fr = FlightRecorder(capacity=0)
+        assert fr.enabled is False
+        fr.note({"request_id": "x"})
+        assert fr.entries() == []
+        assert fr.snapshot()["recorded"] == 0
+
+    def test_dump_roundtrips_and_counts(self, tmp_path):
+        fr = FlightRecorder(capacity=4, clock=FakeClock(9.0))
+        fr.note({"request_id": "req-1", "status": "ok"})
+        path = tmp_path / "out" / "flight_r01_test.json"
+        summary = fr.dump(
+            str(path),
+            reason="test",
+            replica_id="r01",
+            extra={"inflight": {"queued_rows": 2}},
+        )
+        assert summary["path"] == str(path)
+        assert summary["entries"] == 1
+        doc = load_flight_dump(str(path))
+        assert doc["kind"] == "flight_dump"
+        assert doc["reason"] == "test" and doc["replica_id"] == "r01"
+        assert doc["entries"][0]["request_id"] == "req-1"
+        assert doc["extra"]["inflight"]["queued_rows"] == 2
+        assert fr.snapshot()["dumps"] == 1
+
+    def test_dump_is_atomic_no_tmp_left_behind(self, tmp_path):
+        fr = FlightRecorder(capacity=2)
+        path = tmp_path / "flight.json"
+        fr.dump(str(path), reason="x")
+        # tmp+os.replace discipline: the only file is the complete dump
+        assert [p.name for p in tmp_path.iterdir()] == ["flight.json"]
+
+    def test_load_missing_or_corrupt_returns_none(self, tmp_path):
+        assert load_flight_dump(str(tmp_path / "nope.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"kind": "flight_du')  # cut mid-write
+        assert load_flight_dump(str(bad)) is None
+        notdict = tmp_path / "list.json"
+        notdict.write_text("[1, 2]")
+        assert load_flight_dump(str(notdict)) is None
+
+
+# ---------------------------------------------------------------------------
+# incident detector
+# ---------------------------------------------------------------------------
+
+
+def _slo(p99, n=50, shed_total=0):
+    return {
+        "stages": {"lcld": {"dispatch": {"p99": p99, "n": n}}},
+        "shed": {"total": shed_total},
+    }
+
+
+class TestIncidentLifecycle:
+    def test_open_freezes_evidence_at_open_time(self):
+        clock = FakeClock(10.0)
+        det = IncidentDetector(clock=clock)
+        evidence = {"shed": {"total": 3}}
+        inc = det.open("shed_spike", "shed burst", evidence=evidence)
+        evidence["shed"]["total"] = 999  # later tracker mutation
+        assert inc["evidence"]["shed"]["total"] == 3  # frozen copy
+        assert inc["frozen"] is True
+        assert inc["state"] == "open" and inc["t_open"] == 10.0
+
+    def test_unserializable_evidence_degrades_honestly(self):
+        det = IncidentDetector()
+        inc = det.open("slo_breach", "x", evidence={"obj": object()})
+        # default=str serialization keeps SOMETHING; either way the
+        # record never claims more than it holds
+        assert isinstance(inc["evidence"], dict)
+
+    def test_dedupe_counts_repeats_not_new_incidents(self):
+        det = IncidentDetector()
+        first = det.open("slo_breach", "a", dedupe_key="k")
+        again = det.open("slo_breach", "b", dedupe_key="k")
+        assert again is first
+        assert first["repeats"] == 1
+        snap = det.snapshot()
+        assert snap["total"] == 1 and snap["suppressed"] == 1
+
+    def test_cooldown_suppresses_flapping_after_resolve(self):
+        clock = FakeClock()
+        det = IncidentDetector(clock=clock, cooldown_s=60.0)
+        det.open("shed_spike", "a", dedupe_key="k")
+        det.resolve("k", "recovered")
+        clock.advance(10.0)  # inside the cooldown window
+        assert det.open("shed_spike", "b", dedupe_key="k") is None
+        assert det.snapshot()["suppressed"] == 1
+        clock.advance(60.0)  # window over: a genuinely new incident
+        assert det.open("shed_spike", "c", dedupe_key="k") is not None
+        assert det.snapshot()["total"] == 2
+
+    def test_resolve_keeps_the_record_with_evidence(self):
+        det = IncidentDetector()
+        det.open("replica_dead", "r02 killed", evidence={"pid": 7},
+                 dedupe_key="replica_dead:r02")
+        inc = det.resolve("replica_dead:r02", "survivor recovered")
+        assert inc["state"] == "resolved"
+        assert inc["resolve_note"] == "survivor recovered"
+        assert inc["evidence"] == {"pid": 7}  # evidence outlives resolve
+        snap = det.snapshot()
+        assert snap["open"] == 0
+        assert snap["incidents"][0]["state"] == "resolved"
+
+    def test_disabled_detector_is_inert(self):
+        det = IncidentDetector(enabled=False)
+        assert det.open("slo_breach", "x") is None
+        assert det.tick(slo=_slo(10.0)) == []
+        blk = incidents_block(det)
+        assert blk["enabled"] is False and blk["incidents"] == []
+
+    def test_history_bounded(self):
+        clock = FakeClock()
+        det = IncidentDetector(clock=clock, max_history=4, cooldown_s=0.0)
+        for i in range(10):
+            det.open("shed_spike", f"s{i}", dedupe_key=f"k{i}")
+        snap = det.snapshot()
+        assert len(snap["incidents"]) == 4
+        assert snap["total"] == 10  # the count never loses history
+
+
+class TestIncidentPredicates:
+    def test_slo_breach_trips_against_best_seen_p99(self):
+        det = IncidentDetector(p99_factor=3.0, min_samples=20)
+        assert det.tick(slo=_slo(0.010)) == []  # establishes the baseline
+        assert det.tick(slo=_slo(0.020)) == []  # 2x: under the factor
+        opened = det.tick(slo=_slo(0.040), evidence_fn=lambda: {"gap": 1})
+        assert [i["kind"] for i in opened] == ["slo_breach"]
+        inc = opened[0]
+        assert "lcld/dispatch" in inc["summary"]
+        assert inc["evidence"]["trigger"]["p99_s"] == 0.040
+        assert inc["evidence"]["gap"] == 1  # correlated evidence rode along
+        # recovery auto-resolves the open incident
+        det.tick(slo=_slo(0.012))
+        assert det.snapshot()["open"] == 0
+
+    def test_slo_breach_needs_samples(self):
+        det = IncidentDetector(min_samples=20)
+        det.tick(slo=_slo(0.010))
+        assert det.tick(slo=_slo(10.0, n=5)) == []  # too few to judge
+
+    def test_shed_spike_on_delta_not_level(self):
+        det = IncidentDetector(shed_spike_min=8)
+        assert det.tick(slo=_slo(0.01, shed_total=100)) == []  # baseline
+        assert det.tick(slo=_slo(0.01, shed_total=104)) == []  # trickle
+        opened = det.tick(slo=_slo(0.01, shed_total=120))
+        assert [i["kind"] for i in opened] == ["shed_spike"]
+        assert opened[0]["evidence"]["trigger"]["shed_delta"] == 16
+
+    def test_capacity_collapse_against_best_seen(self):
+        cap = lambda qps: {"by_domain": {"lcld": {"max_sustainable_qps": qps}}}
+        det = IncidentDetector(capacity_collapse_ratio=0.5)
+        assert det.tick(capacity=cap(100.0)) == []
+        assert det.tick(capacity=cap(60.0)) == []  # above half of best
+        opened = det.tick(capacity=cap(40.0))
+        assert [i["kind"] for i in opened] == ["capacity_collapse"]
+        # recovery resolves and the best never ratchets down
+        det.tick(capacity=cap(90.0))
+        assert det.snapshot()["open"] == 0
+
+    def test_balance_drop_under_floor(self):
+        det = IncidentDetector(balance_drop_floor=0.5)
+        opened = det.tick(balance_ratio=0.25, balance_label="fleet_routable")
+        assert [i["kind"] for i in opened] == ["balance_drop"]
+        assert "fleet_routable" in opened[0]["summary"]
+        det.tick(balance_ratio=0.9, balance_label="fleet_routable")
+        assert det.snapshot()["open"] == 0
+
+    def test_retrip_of_open_incident_does_not_reopen(self):
+        det = IncidentDetector()
+        det.tick(balance_ratio=0.1)
+        assert det.tick(balance_ratio=0.1) == []  # same condition, ongoing
+        snap = det.snapshot()
+        assert snap["total"] == 1
+        assert snap["incidents"][0]["repeats"] == 1
+
+
+class TestIncidentsSchema:
+    def test_block_carries_required_keys_and_validates(self):
+        det = IncidentDetector()
+        det.open("slo_breach", "x", evidence={"a": 1})
+        blk = incidents_block(det)
+        assert set(INCIDENT_KEYS) <= set(blk)
+        assert validate_incidents(blk) is blk
+        json.dumps(blk)  # strict JSON, record-ready
+
+    def test_validate_rejects_malformed_blocks(self):
+        with pytest.raises(ValueError, match="must be a dict"):
+            validate_incidents([])
+        with pytest.raises(ValueError, match="missing keys"):
+            validate_incidents({"enabled": True})
+        blk = incidents_block(None)
+        blk["incidents"] = [{"id": 1}]  # hand-rolled incident: refused
+        with pytest.raises(ValueError, match="frozen at open time"):
+            validate_incidents(blk)
+
+    def test_capture_off_block_is_valid(self):
+        blk = incidents_block(None)
+        assert blk["enabled"] is False
+        assert validate_incidents(blk) is blk

@@ -20,6 +20,7 @@ from moeva2_ijcai22_replication_tpu.domains.synth import synth_lcld
 from moeva2_ijcai22_replication_tpu.models.io import Surrogate, save_params
 from moeva2_ijcai22_replication_tpu.models.mlp import init_params, lcld_mlp
 from moeva2_ijcai22_replication_tpu.observability import (
+    TraceRecorder,
     current_ledger_context,
     get_ledger,
 )
@@ -608,3 +609,66 @@ class TestQosOffIdentity:
         )
         assert extra_compiles == 0
         assert on_cost["dispatches"] == off_cost["dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# streaming + tracing: the request trace rides the FINAL chunk only
+# ---------------------------------------------------------------------------
+
+
+def _find_events(tree, name):
+    """Depth-first collect of every event node called ``name``."""
+    hits = []
+    for node in tree:
+        if node.get("kind") == "event" and node.get("name") == name:
+            hits.append(node)
+        hits.extend(_find_events(node.get("children", []), name))
+    return hits
+
+
+class TestStreamTraceOnFinalChunk:
+    def test_trace_and_ttfs_ride_final_chunk_only(self, qos_artifacts):
+        """A streamed request's trace (with the ``time_to_first_solved``
+        event) is attached to the final payload's meta by the completion
+        callback; partial chunks stay trace-free (they are row payloads a
+        chunked-HTTP consumer reads mid-flight, not telemetry carriers)."""
+        rec = TraceRecorder(spans_enabled=True)
+        svc = AttackService(
+            {"lcld": qos_artifacts["domain"]},
+            # generous flush delay: the hand-parked partial below is
+            # guaranteed to land before the batch dispatches
+            bucket_sizes=(8,), max_delay_s=0.25,
+            qos=three_tier_policy(), recorder=rec,
+        )
+        try:
+            x = qos_artifacts["pool"][0:3]
+            stream, fut = svc.submit_stream(
+                AttackRequest(domain="lcld", x=x, budget=3, eps=0.2)
+            )
+            # park one solved row by hand — a deterministic stand-in for
+            # the MoEvA early-exit gate (PGD itself streams trivially:
+            # no partials, the final result is the first chunk of truth)
+            stream.put([0], np.asarray(x[0:1]), gen=1)
+            # wait on the STREAM, not the future: finish() runs in the
+            # future's done callback, which may fire after result() wakes
+            for _ in stream.chunks(timeout=120.0):
+                pass
+        finally:
+            svc.close()
+
+        view = stream.poll(0)
+        assert view["done"] and not view["failed"]
+        assert view["rows_streamed"] == 1
+        # partial chunks are pure row payloads — no trace keys ever
+        assert len(view["chunks"]) == 1
+        assert set(view["chunks"][0]) == {"rows", "x", "gen", "t"}
+
+        meta = stream.final["meta"]
+        assert meta["rows_streamed"] == 1
+        assert meta["time_to_first_solved_s"] >= 0.0
+        tree = meta["trace"]
+        ttfs_events = _find_events(tree, "time_to_first_solved")
+        assert len(ttfs_events) == 1
+        attrs = ttfs_events[0]["attrs"]
+        assert attrs["rows_streamed"] == 1
+        assert attrs["seconds"] == meta["time_to_first_solved_s"]

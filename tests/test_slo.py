@@ -50,6 +50,7 @@ from moeva2_ijcai22_replication_tpu.observability import (
     Histogram,
     SloTracker,
     detect_knee,
+    incidents_block,
     slo_block,
     telemetry_block,
     validate_record,
@@ -476,9 +477,19 @@ class TestSloSchema:
         validate_record(dict(base), "bench")  # no slo needed
         with pytest.raises(ValueError, match="slo"):
             validate_record(dict(base), "serving")
-        ok = {
+        # slo alone is no longer enough: serving/fleet records also carry
+        # incident attribution (a capture-off block is honest and valid)
+        with_slo = {
             "execution": {},
             "telemetry": telemetry_block(slo=slo_block()),
+        }
+        with pytest.raises(ValueError, match="incidents"):
+            validate_record(dict(with_slo), "serving")
+        ok = {
+            "execution": {},
+            "telemetry": telemetry_block(
+                slo=slo_block(), incidents=incidents_block(None)
+            ),
         }
         validate_record(ok, "serving")
 
@@ -901,6 +912,59 @@ class TestServiceSlo:
             assert svc_on.metrics_snapshot()["slo"]["stages"], (
                 "capture on must actually record stages"
             )
+        finally:
+            svc_on.close()
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a.x_adv, b.x_adv)
+            assert a.meta["bucket_size"] == b.meta["bucket_size"]
+
+    def test_flight_and_incident_capture_zero_overhead_bit_identical(
+        self, artifacts
+    ):
+        """Same tier-1 bar for the black box + incident detector: capture
+        OFF (flight_ring=0, incident_detection=False) pays the compiles;
+        capture ON must add ZERO new compiles, the same dispatch count,
+        and bit-identical bytes — the ring and the predicate pass are
+        host-side dict work only."""
+        reqs = [
+            AttackRequest(
+                domain="lcld",
+                x=artifacts["pool"][i * 11 : i * 11 + 2 + i],
+                eps=0.25,
+                budget=3,
+            )
+            for i in range(4)
+        ]
+        svc_off = make_service(
+            artifacts, flight_ring=0, incident_detection=False
+        )
+        try:
+            off = [svc_off.attack(r, timeout=300.0) for r in reqs]
+            snap = svc_off.metrics_snapshot()
+            assert snap["flight"]["enabled"] is False
+            assert snap["flight"]["recorded"] == 0
+            assert snap["incidents"]["enabled"] is False
+        finally:
+            svc_off.close()
+        batches_off = svc_off.metrics.counters["batches"]
+        svc_on = make_service(artifacts)  # defaults: both captures on
+        try:
+            on = [svc_on.attack(r, timeout=300.0) for r in reqs]
+            assert svc_on.metrics.counters.get("compiles", 0) == 0, (
+                "flight/incident capture must not add compiles"
+            )
+            assert svc_on.metrics.counters["batches"] == batches_off
+            snap = svc_on.metrics_snapshot()
+            # capture on actually recorded the journeys
+            assert snap["flight"]["recorded"] == len(reqs)
+            entries = svc_on.flight.entries()
+            assert {e["status"] for e in entries} == {"ok"}
+            assert all(
+                {"request_id", "trace_id", "domain", "latency_s",
+                 "batch_seq"} <= set(e)
+                for e in entries
+            )
+            assert snap["incidents"]["enabled"] is True
         finally:
             svc_on.close()
         for a, b in zip(off, on):

@@ -1216,6 +1216,147 @@ def fleet_check(
     return lines, regressed, entries
 
 
+def incidents_check(paths: list[str]) -> tuple[list[str], bool, list[dict]]:
+    """The --incidents gate over the committed ``FLEET_r*.json`` series.
+
+    Incident attribution must not be disarmable by dropping the capture:
+    the LATEST fleet record must carry ``telemetry.incidents`` (pre-
+    incident records skip as baselines, but once ANY record in the series
+    carries the block, losing it fails), every chaos-lost row must be
+    attributed to a specific batch/queue slot via the harvested flight
+    dump (``shed_accounting.flight.attribution.untracked`` empty), the
+    induced kill must appear as a ``replica_dead`` incident, and no
+    incident may be open with unfrozen evidence (an open incident whose
+    evidence failed to freeze is attribution theater). No FLEET records
+    at all passes — the gate arms with the first committed record."""
+    lines: list[str] = []
+    regressed = False
+    entries: list[dict] = []
+
+    def fail(metric: str, msg: str, **extra):
+        nonlocal regressed
+        regressed = True
+        lines.append(f"  incidents.{metric}: {msg} — FAIL")
+        entries.append(
+            {"metric": f"incidents.{metric}", "verdict": "regression", **extra}
+        )
+
+    def ok(metric: str, msg: str, **extra):
+        lines.append(f"  incidents.{metric}: {msg} — ok")
+        entries.append(
+            {"metric": f"incidents.{metric}", "verdict": "ok", **extra}
+        )
+
+    if not paths:
+        lines.append(
+            "  incidents: no FLEET_r*.json records — gate unarmed, passing"
+        )
+        return lines, False, entries
+    records = []
+    for p in paths:
+        doc = load_record(p)
+        rec = doc.get("fleet") if isinstance(doc, dict) else None
+        records.append((p, rec))
+    latest_path, latest = records[-1]
+    lines.append(f"  incidents: gating {latest_path}")
+    if not isinstance(latest, dict):
+        fail("record", f"{latest_path} carries no fleet payload (lost capture)")
+        return lines, regressed, entries
+
+    block = (latest.get("telemetry") or {}).get("incidents")
+    baseline_has = any(
+        isinstance(r, dict) and "incidents" in (r.get("telemetry") or {})
+        for _, r in records[:-1]
+    )
+    if not isinstance(block, dict):
+        if baseline_has:
+            fail(
+                "telemetry.incidents",
+                "missing from the latest record but present in a baseline "
+                "— incident capture was LOST, not never armed",
+            )
+        else:
+            lines.append(
+                "  incidents: series predates incident capture — gate "
+                "unarmed, passing"
+            )
+        return lines, regressed, entries
+
+    # -- every chaos-lost row attributed via the harvested flight dump ------
+    chaos = latest.get("chaos")
+    if isinstance(chaos, dict):
+        acct = chaos.get("shed_accounting") or {}
+        flight = acct.get("flight")
+        lost = acct.get("lost_dead_replica") or 0
+        if not isinstance(flight, dict):
+            fail(
+                "chaos.flight",
+                "no flight block — the kill ran without harvesting the "
+                "victim's black box",
+            )
+        elif lost and not flight.get("harvested"):
+            fail(
+                "chaos.flight.harvested",
+                f"{lost} lost rows but no flight dump harvested — losses "
+                "are countable but not attributable",
+                lost=lost,
+            )
+        else:
+            attr = flight.get("attribution") or {}
+            untracked = attr.get("untracked") or []
+            if untracked:
+                fail(
+                    "chaos.flight.untracked",
+                    f"{len(untracked)} lost rows the flight dump never saw "
+                    f"({untracked[:4]}{'...' if len(untracked) > 4 else ''})",
+                    untracked=len(untracked),
+                )
+            else:
+                ok(
+                    "chaos.flight",
+                    f"{attr.get('attributed', 0)}/{lost} lost rows "
+                    f"attributed ({attr.get('by_where')})",
+                    attributed=attr.get("attributed", 0),
+                    lost=lost,
+                )
+        # the induced kill must be an incident on the record
+        by_kind = block.get("by_kind") or {}
+        if not by_kind.get("replica_dead"):
+            fail(
+                "replica_dead",
+                "chaos segment killed a replica but no replica_dead "
+                "incident was opened",
+            )
+        else:
+            ok(
+                "replica_dead",
+                f"{by_kind['replica_dead']} incident(s) for the induced kill",
+            )
+
+    # -- no open incident with unfrozen evidence ----------------------------
+    bad = [
+        i
+        for i in block.get("incidents") or []
+        if i.get("state") == "open" and not i.get("frozen")
+    ]
+    if bad:
+        fail(
+            "frozen",
+            f"{len(bad)} open incident(s) with unfrozen evidence "
+            f"(first: {bad[0].get('kind')}/{bad[0].get('summary')!r})",
+            open_unfrozen=len(bad),
+        )
+    else:
+        ok(
+            "frozen",
+            f"{block.get('open', 0)} open / {block.get('total', 0)} total "
+            "incidents, all evidence frozen at open",
+            open=block.get("open", 0),
+            total=block.get("total", 0),
+        )
+    return lines, regressed, entries
+
+
 def qos_check(
     paths: list[str],
     *,
@@ -1517,6 +1658,18 @@ def main(argv=None) -> int:
         f"fails under --fleet (default {DEFAULT_FLEET_THRESHOLD})",
     )
     parser.add_argument(
+        "--incidents",
+        action="store_true",
+        help="also gate incident attribution on the committed "
+        "FLEET_r*.json series (globbed in cwd): the latest record must "
+        "carry telemetry.incidents (capture loss fails once any baseline "
+        "has it), every chaos-lost row must be attributed to a specific "
+        "batch via the harvested flight dump, the induced kill must "
+        "appear as a replica_dead incident, and no incident may be open "
+        "with unfrozen evidence. No FLEET records passes (the gate arms "
+        "with the first)",
+    )
+    parser.add_argument(
         "--qos",
         action="store_true",
         help="also gate the committed QOS_r*.json series (globbed in cwd): "
@@ -1569,6 +1722,16 @@ def main(argv=None) -> int:
             threshold=args.fleet_threshold,
         )
 
+    # the incidents gate reads the same FLEET series with its own
+    # predicate family (attribution, not throughput)
+    inc_lines: list[str] = []
+    inc_regressed = False
+    inc_entries: list[dict] = []
+    if args.incidents:
+        inc_lines, inc_regressed, inc_entries = incidents_check(
+            sorted(glob.glob("FLEET_r*.json"))
+        )
+
     # the QOS series mirrors the FLEET discipline: its own file family,
     # gated independently of the BENCH series
     qos_lines: list[str] = []
@@ -1581,7 +1744,7 @@ def main(argv=None) -> int:
             ttfs_floor=args.qos_ttfs_floor,
         )
 
-    if not paths and not args.fleet and not args.qos:
+    if not paths and not args.fleet and not args.qos and not args.incidents:
         parser.error("no bench records given (and --check found none)")
 
     # records are taken in the order GIVEN (oldest first, per the CLI
@@ -1606,6 +1769,14 @@ def main(argv=None) -> int:
                 if fleet_regressed
                 else "bench_diff: fleet ok"
             )
+        if inc_lines:
+            print("incidents gate:")
+            print("\n".join(inc_lines))
+            print(
+                "bench_diff: incidents REGRESSION — failing"
+                if inc_regressed
+                else "bench_diff: incidents ok"
+            )
         if qos_lines:
             print("qos gate:")
             print("\n".join(qos_lines))
@@ -1614,18 +1785,20 @@ def main(argv=None) -> int:
                 if qos_regressed
                 else "bench_diff: qos ok"
             )
+        any_regressed = fleet_regressed or qos_regressed or inc_regressed
         if args.json:
             print(
                 json.dumps(
-                    {"regressed": fleet_regressed or qos_regressed,
+                    {"regressed": any_regressed,
                      "reason": "insufficient_records",
                      "usable_records": len(records),
                      "fleet": args.fleet,
+                     "incidents": args.incidents,
                      "qos": args.qos,
-                     "metrics": fleet_entries + qos_entries}
+                     "metrics": fleet_entries + inc_entries + qos_entries}
                 )
             )
-        return 1 if (fleet_regressed or qos_regressed) else 0
+        return 1 if any_regressed else 0
 
     print(
         f"bench_diff: {records[-1][0]} vs {len(records) - 1} earlier "
@@ -1649,11 +1822,14 @@ def main(argv=None) -> int:
     if fleet_lines:
         print("fleet gate:")
         print("\n".join(fleet_lines))
+    if inc_lines:
+        print("incidents gate:")
+        print("\n".join(inc_lines))
     if qos_lines:
         print("qos gate:")
         print("\n".join(qos_lines))
-    regressed = regressed or fleet_regressed or qos_regressed
-    entries = entries + fleet_entries + qos_entries
+    regressed = regressed or fleet_regressed or qos_regressed or inc_regressed
+    entries = entries + fleet_entries + inc_entries + qos_entries
     if regressed:
         print("bench_diff: REGRESSION past threshold — failing")
     else:
@@ -1681,6 +1857,7 @@ def main(argv=None) -> int:
                     "fleet_warm_floor": args.fleet_warm_floor,
                     "fleet_recovery_floor": args.fleet_recovery_floor,
                     "fleet_threshold": args.fleet_threshold,
+                    "incidents": args.incidents,
                     "qos": args.qos,
                     "qos_shed_floor": args.qos_shed_floor,
                     "qos_ttfs_floor": args.qos_ttfs_floor,

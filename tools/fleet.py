@@ -73,6 +73,16 @@ def main(argv=None) -> int:
         boot_timeout_s=fleet_cfg.get("boot_timeout_s", 600.0),
         autoscale=fleet_cfg.get("autoscale"),
     )
+    # router-level incident attribution (balance_drop off the served
+    # counters, ticked at /healthz) — same serving.incident_detection
+    # switch the replicas honour, so one knob silences the whole fleet
+    incidents = None
+    if cfg.get("serving", {}).get("incident_detection", True):
+        from moeva2_ijcai22_replication_tpu.observability.incidents import (
+            IncidentDetector,
+        )
+
+        incidents = IncidentDetector()
     router = Router(
         manager,
         retry_budget=fleet_cfg.get("retry_budget", 2),
@@ -80,6 +90,7 @@ def main(argv=None) -> int:
         capacity_age_max_s=fleet_cfg.get("capacity_age_max_s", 30.0),
         request_timeout_s=cfg.get("serving", {}).get("request_timeout_s", 60.0)
         + 30.0,
+        incidents=incidents,
     )
     try:
         for _ in range(int(n)):
@@ -132,6 +143,44 @@ def main(argv=None) -> int:
             print(
                 f"fleet: drained {report['replica_id']} "
                 f"(clean={report['drained_clean']}, {report['drain_s']}s)",
+                flush=True,
+            )
+        # fleet.trace_merge: after the drain (sinks complete), merge the
+        # per-replica JSONL sinks into ONE Perfetto doc aligned via each
+        # replica's last polled clock offset. `true` places the doc next
+        # to the sinks; a string is the output path.
+        merge_out = fleet_cfg.get("trace_merge")
+        trace_log = cfg.get("serving", {}).get("trace_log") or cfg.get(
+            "system", {}
+        ).get("trace_log")
+        if merge_out and trace_log:
+            from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+                merge_fleet_traces,
+                replica_sink_path,
+            )
+
+            out = (
+                merge_out
+                if isinstance(merge_out, str)
+                else os.path.join(
+                    os.path.dirname(trace_log) or ".", "fleet_trace.json"
+                )
+            )
+            handles = manager.replicas()
+            doc = merge_fleet_traces(
+                {
+                    h.replica_id: replica_sink_path(trace_log, h.replica_id)
+                    for h in handles
+                },
+                offsets={
+                    h.replica_id: h.clock_offset_s or 0.0 for h in handles
+                },
+                out_path=out,
+            )
+            rep = doc["otherData"]["fleet_merge"]
+            print(
+                f"fleet: merged {len(rep['replicas'])} trace sinks -> "
+                f"{out} (skipped: {sorted(rep['skipped'])})",
                 flush=True,
             )
         manager.close()

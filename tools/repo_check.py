@@ -65,7 +65,7 @@ GATES = {
     "bench_diff": (
         "bench_diff.py",
         ["--check", "--slo", "--mesh", "--overlap", "--cold", "--fleet",
-         "--qos"],
+         "--qos", "--incidents"],
     ),
     "shard_lint": ("shard_lint.py", ["--check"]),
     "domain_lint": ("domain_lint.py", ["--check"]),

@@ -76,6 +76,15 @@ def main(argv=None) -> int:
     if trace_log:
         from moeva2_ijcai22_replication_tpu.observability import TraceRecorder
 
+        from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+            replica_sink_path,
+        )
+
+        # N replicas share ONE config file: template the sink path per
+        # replica (events interleaved from two processes into one JSONL
+        # would corrupt both streams; the fleet merge reads the same
+        # templated paths back — tools/trace_export.py --fleet)
+        trace_log = replica_sink_path(trace_log, args.replica_id)
         recorder = TraceRecorder(sink_path=trace_log)
 
     service = AttackService(
@@ -90,6 +99,9 @@ def main(argv=None) -> int:
         capacity_window=srv_cfg.get("capacity_window", 256),
         replica_id=args.replica_id,
         qos=qos,
+        flight_ring=srv_cfg.get("flight_ring", 64),
+        incident_detection=srv_cfg.get("incident_detection", True),
+        flight_dir=srv_cfg.get("flight_dir", "out"),
     )
     # boot-time prewarm: BEFORE the HTTP front binds, so the first caller
     # never pays a compile (engines are single-dispatch objects — this
@@ -138,6 +150,24 @@ def main(argv=None) -> int:
         f"buckets {list(service.menu.sizes)})",
         flush=True,
     )
+    # dump-on-SIGTERM: the graceful-drain signal (ReplicaManager's
+    # _terminate sends it) leaves a moment SIGKILL never does — use it to
+    # land the black box before the process exits, so even a drained
+    # replica's last journeys are on disk for the fleet harvest
+    import signal as _signal
+
+    def _sigterm(_signum, _frame):
+        try:
+            service.flight_dump("sigterm")
+        except Exception:  # noqa: BLE001 — dying anyway; dump is best-effort
+            pass
+        raise SystemExit(0)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: dump only via POST
+
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

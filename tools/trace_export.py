@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Render a trace JSONL sink to Chrome/Perfetto trace-event JSON.
+"""Render trace JSONL sinks to Chrome/Perfetto trace-event JSON.
 
 The unified tracing subsystem (``moeva2_ijcai22_replication_tpu/observability``)
 appends one JSON event per line to the path configured as
@@ -12,6 +12,19 @@ gauges (writer queue depth).
 
     python tools/trace_export.py out/trace.jsonl
     python tools/trace_export.py out/trace.jsonl -o trace.perfetto.json
+
+Fleet mode merges N per-replica sinks onto one wall-clock timeline (each
+sink's meta line anchors its epoch; ``--offsets`` applies the measured
+router<->replica clock offsets the ReplicaManager's healthz handshake
+reports as ``clock_offset_s`` in the fleet view):
+
+    python tools/trace_export.py --fleet out/trace_r01.jsonl \
+        out/trace_r02.jsonl -o fleet.perfetto.json \
+        --offsets '{"r01": 0.0, "r02": -0.0012}'
+
+Labels default to the ``rNN``-style suffix of each filename (the
+per-replica templating ``tools/serve.py`` applies); pass ``label=path``
+to override.
 """
 
 from __future__ import annotations
@@ -19,19 +32,48 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _label_for(path: str) -> str:
+    """Infer a replica label from a sink filename: the trailing
+    ``_<label>`` chunk serve.py's per-replica templating appends
+    (``trace_r02.jsonl`` -> ``r02``), else the bare stem."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    m = re.search(r"_([A-Za-z0-9-]+)$", stem)
+    return m.group(1) if m else stem
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("path", help="trace JSONL file (system.trace_log)")
+    parser.add_argument(
+        "paths",
+        nargs="+",
+        help="trace JSONL file(s); with --fleet, each may be "
+        "'label=path' to name its replica track explicitly",
+    )
     parser.add_argument(
         "-o",
         "--out",
         default=None,
-        help="output path (default: <path>.perfetto.json)",
+        help="output path (default: <path>.perfetto.json, or "
+        "fleet.perfetto.json next to the first sink in --fleet mode)",
+    )
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="merge multiple per-replica sinks into ONE document with "
+        "per-replica tracks aligned on a shared wall-clock timeline",
+    )
+    parser.add_argument(
+        "--offsets",
+        default=None,
+        help="fleet mode: replica wall-clock offsets — inline JSON or a "
+        "path to a JSON file mapping label -> offset seconds (the "
+        "fleet view's per-replica clock_offset_s)",
     )
     args = parser.parse_args(argv)
 
@@ -40,15 +82,58 @@ def main(argv=None) -> int:
         to_chrome_trace,
     )
 
-    events = read_jsonl(args.path)
+    if args.fleet:
+        from moeva2_ijcai22_replication_tpu.observability.fleetrace import (
+            merge_fleet_traces,
+        )
+
+        sinks: dict[str, str] = {}
+        for spec in args.paths:
+            if "=" in spec:
+                label, path = spec.split("=", 1)
+            else:
+                label, path = _label_for(spec), spec
+            sinks[label] = path
+        offsets = None
+        if args.offsets:
+            if os.path.exists(args.offsets):
+                with open(args.offsets) as fh:
+                    offsets = json.load(fh)
+            else:
+                offsets = json.loads(args.offsets)
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(args.paths[0].split("=", 1)[-1])),
+            "fleet.perfetto.json",
+        )
+        doc = merge_fleet_traces(sinks, offsets, out_path=out)
+        report = doc["otherData"]["fleet_merge"]
+        for label, info in sorted(report["replicas"].items()):
+            print(
+                f"  {label}: {info['events']} events, offset "
+                f"{info['offset_s']}s, shift {info['shift_s']}s"
+            )
+        for label, why in sorted(report["skipped"].items()):
+            print(f"  {label}: SKIPPED ({why})", file=sys.stderr)
+        if not report["replicas"]:
+            print("warning: no sink contributed events", file=sys.stderr)
+        print(
+            f"{len(report['replicas'])} replica sinks -> "
+            f"{len(doc['traceEvents'])} trace-event records -> {out}"
+        )
+        return 0
+
+    if len(args.paths) != 1:
+        parser.error("multiple sinks need --fleet (single-sink mode merges nothing)")
+    path = args.paths[0]
+    events = read_jsonl(path)
     doc = to_chrome_trace(events)
-    out = args.out or f"{args.path}.perfetto.json"
+    out = args.out or f"{path}.perfetto.json"
     with open(out, "w") as fh:
         json.dump(doc, fh)
     if not events:
         # an empty or fully-truncated sink still yields a valid (empty)
         # Perfetto document — warn instead of stack-tracing
-        print(f"warning: {args.path} contained no parseable trace events",
+        print(f"warning: {path} contained no parseable trace events",
               file=sys.stderr)
     print(
         f"{len(events)} trace events -> {len(doc['traceEvents'])} "
